@@ -1,0 +1,184 @@
+"""Helper API available to user ``main_fun(args, ctx)`` code on each node.
+
+Capability-parity with /root/reference/tensorflowonspark/TFNode.py: filesystem
+path normalization, cluster bootstrap, model export, and — the heart of
+``InputMode.SPARK`` — the :class:`DataFeed` consumer that turns the executor's
+IPC queue into batches ready for ``jax.device_put`` / host infeed.
+
+TPU-native differences:
+* ``start_cluster_server`` (TF1 grpc bootstrap, reference TFNode.py:67-129) is
+  replaced by ``ctx``-driven ``jax.distributed`` initialization performed by the
+  node runtime before ``main_fun`` runs; a stub remains for API familiarity.
+* ``DataFeed.next_batch`` can return columnar numpy arrays (``as_numpy=True``)
+  so a batch can go straight onto the chips without a Python-loop transpose.
+"""
+
+import getpass
+import logging
+
+from tensorflowonspark_tpu.marker import EndPartition
+
+logger = logging.getLogger(__name__)
+
+#: URI schemes recognized as absolute filesystem locations
+#: (reference TFNode.py:40-49, plus ``gs`` as a first-class TPU-era scheme).
+_FS_SCHEMES = (
+    "file",
+    "hdfs",
+    "viewfs",
+    "gs",
+    "s3",
+    "s3a",
+    "s3n",
+    "wasb",
+    "wasbs",
+    "adl",
+    "abfs",
+    "abfss",
+)
+
+
+def hdfs_path(ctx, path):
+    """Normalize a path relative to the cluster's default filesystem.
+
+    Mirrors reference TFNode.py:29-64: absolute URIs pass through, absolute
+    paths are anchored at the default FS, relative paths land under the user's
+    home directory on the default FS.
+    """
+    if any(path.startswith(scheme + "://") for scheme in _FS_SCHEMES):
+        return path
+    defaultFS = getattr(ctx, "defaultFS", None) or "file://"
+    # normalize: keep the '://' but drop any trailing path slash so joins are clean
+    base = defaultFS[:-1] if defaultFS.endswith("/") and not defaultFS.endswith("://") else defaultFS
+    if path.startswith("/"):
+        return base + path
+    if base.startswith("file://"):
+        # local FS: resolve relative to the working dir like the reference
+        import os
+
+        working = getattr(ctx, "working_dir", None) or os.getcwd()
+        return "{}{}/{}".format(base, working, path)
+    return "{}/user/{}/{}".format(base, getpass.getuser(), path)
+
+
+def start_cluster_server(ctx, num_gpus=1, rdma=False):
+    """Deprecated TF1-era bootstrap (reference TFNode.py:67-129).
+
+    On TPU the distributed runtime is initialized by the node runtime itself
+    (jax.distributed over the reservation-elected coordinator) before user code
+    runs; there is no per-node server object to start.
+    """
+    raise NotImplementedError(
+        "start_cluster_server is a TF1 grpc concept; the jax.distributed "
+        "runtime is already initialized before main_fun runs — use ctx.mesh() "
+        "or tensorflowonspark_tpu.parallel directly."
+    )
+
+
+def export_saved_model(*args, **kwargs):
+    """Reference TFNode.py:159 exported a TF1 SavedModel; the TPU-native
+    equivalent is :mod:`tensorflowonspark_tpu.train.checkpoint` (orbax)."""
+    from tensorflowonspark_tpu.train import checkpoint
+
+    return checkpoint.export_saved_model(*args, **kwargs)
+
+
+class DataFeed:
+    """Consumer side of ``InputMode.SPARK`` feeding, running inside the jax
+    process; reads items the Spark feed tasks pushed through the executor IPC
+    channel (reference TFNode.py:221-329).
+
+    Semantics pinned by the reference and its tests:
+
+    * ``None`` on the queue ⇒ end of feed; ``next_batch`` returns the partial
+      batch and ``should_stop()`` becomes True (TFNode.py:267-272).
+    * :class:`EndPartition` ⇒ end the current batch early without ending the
+      feed (TFNode.py:273-278) — inference uses this to align results with
+      partitions.
+    * With ``input_mapping``, batches are dicts keyed by tensor/feature name,
+      one list (or numpy array) per column, with columns matched to the sorted
+      input column order (TFNode.py:261,281-286).
+    """
+
+    def __init__(self, mgr, train_mode=True, qname_in="input", qname_out="output", input_mapping=None):
+        self.mgr = mgr
+        self.train_mode = train_mode
+        self.qname_in = qname_in
+        self.qname_out = qname_out
+        self.done_feeding = False
+        self.input_tensors = (
+            [input_mapping[col] for col in sorted(input_mapping)] if input_mapping else None
+        )
+
+    def next_batch(self, batch_size, as_numpy=False):
+        """Get up to ``batch_size`` items from the feed queue.
+
+        Returns a list of items, or — when ``input_mapping`` was supplied — a
+        dict of columns keyed by tensor name. ``as_numpy=True`` stacks columns
+        into numpy arrays (device-put ready).
+        """
+        logger.debug("next_batch(%d)", batch_size)
+        queue_in = self.mgr.get_queue(self.qname_in)
+        tensors = [] if self.input_tensors is None else {t: [] for t in self.input_tensors}
+        count = 0
+        while count < batch_size:
+            item = queue_in.get(block=True)
+            if item is None:
+                # end-of-feed marker from shutdown (TFSparkNode.py:560-569)
+                logger.info("next_batch: end of feed")
+                queue_in.task_done()
+                self.done_feeding = True
+                break
+            elif isinstance(item, EndPartition):
+                # end current batch at a partition boundary
+                logger.debug("next_batch: end of partition")
+                queue_in.task_done()
+                if count > 0:
+                    break
+            else:
+                if self.input_tensors is None:
+                    tensors.append(item)
+                else:
+                    for i, t in enumerate(self.input_tensors):
+                        tensors[t].append(item[i])
+                count += 1
+                queue_in.task_done()
+        logger.debug("next_batch: returning %d items", count)
+        if as_numpy:
+            import numpy as np
+
+            if self.input_tensors is None:
+                return np.asarray(tensors)
+            return {t: np.asarray(col) for t, col in tensors.items()}
+        return tensors
+
+    def should_stop(self):
+        """True once the end-of-feed marker was consumed."""
+        return self.done_feeding
+
+    def batch_results(self, results):
+        """Push a batch of inference results to the output queue; the contract
+        is 1:1 with consumed inputs (reference TFNode.py:294-305)."""
+        queue_out = self.mgr.get_queue(self.qname_out)
+        for item in results:
+            queue_out.put(item, block=True)
+
+    def terminate(self):
+        """Request feeder termination: flips the executor state machine to
+        ``'terminating'`` and drains the input queue so blocked feed tasks can
+        finish (reference TFNode.py:307-329)."""
+        logger.info("DataFeed.terminate: requesting stop of data feed")
+        self.mgr.set("state", "terminating")
+        queue_in = self.mgr.get_queue(self.qname_in)
+        # drain with a short patience window: feed tasks may still be pushing
+        import time
+
+        empty_checks = 0
+        while empty_checks < 3:
+            try:
+                queue_in.get_nowait()
+                queue_in.task_done()
+                empty_checks = 0
+            except Exception:
+                empty_checks += 1
+                time.sleep(0.1)

@@ -1,0 +1,27 @@
+"""Sentinel markers used on the feed queues.
+
+Mirrors the roles of the reference's markers
+(/root/reference/tensorflowonspark/marker.py:11-16): ``None`` on a feed queue is
+the implicit end-of-feed signal, :class:`EndPartition` separates RDD partitions
+so an inference task can collect exactly the results for its own partition.
+"""
+
+
+class Marker:
+    """Base class for control markers placed on data queues."""
+
+    __slots__ = ()
+
+    def __repr__(self):  # pragma: no cover - cosmetic
+        return "<{}>".format(type(self).__name__)
+
+
+class EndPartition(Marker):
+    """Marks the end of one RDD partition within a continuing feed."""
+
+    __slots__ = ()
+
+
+#: The end-of-feed marker. Kept as ``None`` for wire-compat with the reference
+#: semantics (/root/reference/tensorflowonspark/TFNode.py:267).
+END_OF_FEED = None

@@ -1,0 +1,351 @@
+"""Driver-hosted reservation / coordination control plane.
+
+TPU-native re-design of the reference's reservation protocol
+(/root/reference/tensorflowonspark/reservation.py). Same capability — every
+executor registers exactly one reservation, the driver blocks until the cluster
+is fully assembled, clients can fetch the final cluster info and request an
+early stop — with deliberate differences:
+
+* Wire format is length-prefixed **JSON**, not pickle: executors should not be
+  able to execute arbitrary code on the driver via the control socket
+  (reference framing: reservation.py:68-97).
+* Reservations carry TPU topology (local chip count, process index hints) and
+  the assembled cluster info is the input to ``jax.distributed.initialize`` —
+  the server is the natural coordinator-election point (SURVEY.md §2.8).
+* The store uses a condition variable instead of busy-polling where possible,
+  but the driver-side ``await_reservations`` still polls with a timeout so it
+  can abort on executor errors reported out-of-band (reference
+  reservation.py:113-126).
+
+Environment overrides ``TOS_TPU_SERVER_HOST`` / ``TOS_TPU_SERVER_PORT`` mirror
+the reference's ``TFOS_SERVER_HOST/PORT`` (reservation.py:25-26) for NAT'd or
+proxied driver setups.
+"""
+
+import json
+import logging
+import os
+import selectors
+import socket
+import struct
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+#: env var: externally-visible host for the server (NAT / container setups)
+ENV_SERVER_HOST = "TOS_TPU_SERVER_HOST"
+#: env var: fixed listening port for the server
+ENV_SERVER_PORT = "TOS_TPU_SERVER_PORT"
+
+_HEADER = struct.Struct(">I")
+_MAX_MSG = 64 * 1024 * 1024
+
+
+class ReservationError(Exception):
+    """Raised when the cluster cannot be assembled (timeout or node error)."""
+
+
+class Reservations:
+    """Thread-safe store of node reservations (reference reservation.py:31-65).
+
+    ``required`` is the number of reservations that completes the cluster.
+    """
+
+    def __init__(self, required):
+        self.required = required
+        self._lock = threading.Condition()
+        self._entries = []
+
+    def add(self, meta):
+        """Add (or idempotently replace) one reservation.
+
+        Dedup key: ``executor_id`` when present. Spark retries tasks and the
+        client retries lost replies, so REG must be idempotent — the reference
+        handled retried tasks by reusing prior reservations
+        (TFSparkNode.py:240-249); we dedup at the store instead.
+        """
+        with self._lock:
+            key = meta.get("executor_id") if isinstance(meta, dict) else None
+            if key is not None:
+                for i, existing in enumerate(self._entries):
+                    if isinstance(existing, dict) and existing.get("executor_id") == key:
+                        self._entries[i] = meta
+                        self._lock.notify_all()
+                        return
+            self._entries.append(meta)
+            if self.done:
+                self._lock.notify_all()
+
+    def get(self):
+        with self._lock:
+            return list(self._entries)
+
+    def remaining(self):
+        with self._lock:
+            return self.required - len(self._entries)
+
+    @property
+    def done(self):
+        return len(self._entries) >= self.required
+
+    def wait(self, timeout=None):
+        """Block until complete; returns True if complete."""
+        deadline = None if timeout is None else time.time() + timeout
+        with self._lock:
+            while not self.done:
+                remaining = None if deadline is None else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._lock.wait(timeout=remaining)
+            return True
+
+
+class MessageSocket:
+    """Length-prefixed JSON framing over a stream socket."""
+
+    def __init__(self, sock):
+        self.sock = sock
+
+    def send(self, obj):
+        payload = json.dumps(obj).encode("utf-8")
+        self.sock.sendall(_HEADER.pack(len(payload)) + payload)
+
+    def recv(self):
+        header = self._recv_exact(_HEADER.size)
+        if header is None:
+            return None
+        (length,) = _HEADER.unpack(header)
+        if length > _MAX_MSG:
+            raise ReservationError("control message too large: {} bytes".format(length))
+        payload = self._recv_exact(length)
+        if payload is None:
+            return None
+        return json.loads(payload.decode("utf-8"))
+
+    def _recv_exact(self, n):
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class Server:
+    """Reservation server hosted on the Spark driver.
+
+    One instance per cluster. ``start()`` spawns a daemon listener thread
+    multiplexing all executor clients with a selector (reference ran a
+    select()-loop thread, reservation.py:148-188).
+    """
+
+    def __init__(self, count):
+        if count <= 0:
+            raise ValueError("reservation count must be positive")
+        self.reservations = Reservations(count)
+        self._stop_requested = threading.Event()
+        self._shutdown = threading.Event()
+        self._sock = None
+        self._thread = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        """Bind, listen and serve in a daemon thread. Returns (host, port)."""
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        port = int(os.environ.get(ENV_SERVER_PORT, "0"))
+        self._sock.bind(("", port))
+        self._sock.listen(64)
+        self._thread = threading.Thread(
+            target=self._serve, name="tos-reservation-server", daemon=True
+        )
+        self._thread.start()
+        host = os.environ.get(ENV_SERVER_HOST)
+        if not host:
+            from tensorflowonspark_tpu import util
+
+            host = util.get_ip_address()
+        addr = (host, self._sock.getsockname()[1])
+        logger.info("reservation server listening at %s", addr)
+        return addr
+
+    def stop(self):
+        self._shutdown.set()
+        # connect to ourselves to wake the selector promptly
+        try:
+            with socket.create_connection(
+                ("127.0.0.1", self._sock.getsockname()[1]), timeout=1
+            ):
+                pass
+        except OSError:
+            pass
+
+    @property
+    def stop_requested(self):
+        """True once any client sent STOP (early-termination request)."""
+        return self._stop_requested.is_set()
+
+    # -- driver-side wait ----------------------------------------------------
+
+    def await_reservations(self, status=None, timeout=600, poll_interval=1.0):
+        """Block the driver until all nodes reserved.
+
+        ``status`` is a shared dict the background launch thread writes an
+        ``'error'`` key into when an executor fails during startup; we abort
+        immediately in that case (reference reservation.py:113-126 +
+        TFCluster.py:314-331).
+        """
+        deadline = time.time() + timeout
+        while not self.reservations.done:
+            if status and status.get("error"):
+                raise ReservationError(
+                    "cluster startup aborted by node failure: {}".format(status["error"])
+                )
+            if time.time() > deadline:
+                raise ReservationError(
+                    "timed out waiting for {} node(s) to register (of {})".format(
+                        self.reservations.remaining(), self.reservations.required
+                    )
+                )
+            self.reservations.wait(timeout=poll_interval)
+        logger.info(
+            "all %d node(s) reserved", self.reservations.required
+        )
+        return self.reservations.get()
+
+    # -- server internals ----------------------------------------------------
+
+    def _serve(self):
+        sel = selectors.DefaultSelector()
+        sel.register(self._sock, selectors.EVENT_READ, data=None)
+        try:
+            while not self._shutdown.is_set():
+                for key, _ in sel.select(timeout=0.5):
+                    if key.data is None:
+                        try:
+                            conn, _addr = self._sock.accept()
+                        except OSError:
+                            continue
+                        # bounded blocking reads: a stalled client must not
+                        # wedge the single-threaded control plane
+                        conn.settimeout(10.0)
+                        sel.register(conn, selectors.EVENT_READ, data=MessageSocket(conn))
+                    else:
+                        msock = key.data
+                        try:
+                            msg = msock.recv()
+                        except (OSError, ValueError, ReservationError):
+                            msg = None
+                        if msg is None:
+                            sel.unregister(msock.sock)
+                            msock.close()
+                            continue
+                        try:
+                            self._handle(msock, msg)
+                        except OSError:
+                            sel.unregister(msock.sock)
+                            msock.close()
+                        except Exception as e:  # malformed-but-valid-JSON input
+                            logger.warning("dropping bad control message %r: %s", msg, e)
+                            sel.unregister(msock.sock)
+                            msock.close()
+        finally:
+            for key in list(sel.get_map().values()):
+                if key.data is not None:
+                    key.data.close()
+            sel.close()
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _handle(self, msock, msg):
+        """Dispatch one control message (reference reservation.py:130-146)."""
+        kind = msg.get("type") if isinstance(msg, dict) else None
+        if kind == "REG":
+            self.reservations.add(msg.get("data", {}))
+            msock.send({"type": "OK"})
+        elif kind == "QUERY":
+            msock.send({"type": "DONE", "data": self.reservations.done})
+        elif kind == "QINFO":
+            msock.send({"type": "INFO", "data": self.reservations.get()})
+        elif kind == "QSTOP":
+            msock.send({"type": "STOPPED", "data": self.stop_requested})
+        elif kind == "STOP":
+            logger.info("stop requested via control plane")
+            self._stop_requested.set()
+            msock.send({"type": "OK"})
+        else:
+            msock.send({"type": "ERROR", "data": "unknown message type {!r}".format(kind)})
+
+
+class Client:
+    """Executor-side client for the reservation server.
+
+    Opens one connection per request with bounded retries, because executors
+    may race the server's startup and Spark may retry tasks (reference kept a
+    connection but reconnect-retried ×3, reservation.py:221-246).
+    """
+
+    RETRIES = 3
+
+    def __init__(self, server_addr, timeout=30):
+        self.server_addr = (server_addr[0], int(server_addr[1]))
+        self.timeout = timeout
+
+    def _request(self, msg):
+        last_err = None
+        for attempt in range(self.RETRIES):
+            try:
+                with socket.create_connection(self.server_addr, timeout=self.timeout) as sock:
+                    msock = MessageSocket(sock)
+                    msock.send(msg)
+                    reply = msock.recv()
+                    if reply is None:
+                        raise ReservationError("server closed connection")
+                    if reply.get("type") == "ERROR":
+                        raise ReservationError(str(reply.get("data")))
+                    return reply
+            except (OSError, ReservationError) as e:
+                last_err = e
+                if attempt < self.RETRIES - 1:
+                    time.sleep(min(2 ** attempt, 5))
+        raise ReservationError(
+            "could not reach reservation server at {}: {}".format(self.server_addr, last_err)
+        )
+
+    # -- API -----------------------------------------------------------------
+
+    def register(self, reservation):
+        self._request({"type": "REG", "data": reservation})
+
+    def get_reservations(self):
+        return self._request({"type": "QINFO"})["data"]
+
+    def await_reservations(self, timeout=600, poll_interval=1.0):
+        """Poll until the cluster is complete; returns the full cluster info."""
+        deadline = time.time() + timeout
+        while True:
+            if self._request({"type": "QUERY"})["data"]:
+                return self.get_reservations()
+            if time.time() > deadline:
+                raise ReservationError("timed out awaiting full cluster")
+            time.sleep(poll_interval)
+
+    def request_stop(self):
+        self._request({"type": "STOP"})
+
+    def stop_requested(self):
+        return self._request({"type": "QSTOP"})["data"]
+
+    def close(self):  # connections are per-request; kept for API parity
+        pass

@@ -1,0 +1,7 @@
+"""Training strategies, step builders and checkpointing for the TPU runtime.
+
+This package is the replacement for the reference's reliance on
+``tf.distribute.*Strategy`` + TF checkpointing (SURVEY.md §2.6/§5): sync data
+parallelism is a pjit program over a ``jax.sharding.Mesh`` with XLA collectives
+over ICI, and checkpoint/resume is orbax.
+"""

@@ -1,0 +1,75 @@
+"""Checkpoint / export helpers (orbax-backed).
+
+Capability-parity with the reference's checkpoint story, which was fully
+delegated to TensorFlow (SURVEY.md §5 "Checkpoint / resume";
+/root/reference/tensorflowonspark/compat.py:10-17 chief-vs-worker export dance).
+On TPU, orbax is the native checkpointer: async-capable, sharding-aware
+(restores distributed arrays directly onto their mesh shards), and
+multi-host-safe (only process 0 writes metadata; every host writes its own
+shards).
+"""
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(path, state, force=True):
+    """Save a pytree ``state`` (params/opt-state/step) to ``path``.
+
+    Unlike the reference's chief-only TF checkpointing, orbax wants *every*
+    process to call save in a multi-host setup; non-primary hosts write their
+    own array shards (the reference instead had workers save to a throwaway
+    'worker_model' dir, compat.py:15-17 — that dance is unnecessary here).
+    """
+    path = os.path.abspath(os.path.expanduser(path))
+    ckptr = _checkpointer()
+    ckptr.save(path, state, force=force)
+    ckptr.wait_until_finished()
+    logger.info("saved checkpoint to %s", path)
+    return path
+
+
+def restore_checkpoint(path, target=None):
+    """Restore a pytree from ``path``; ``target`` gives structure/shardings."""
+    path = os.path.abspath(os.path.expanduser(path))
+    ckptr = _checkpointer()
+    state = ckptr.restore(path, target) if target is not None else ckptr.restore(path)
+    logger.info("restored checkpoint from %s", path)
+    return state
+
+
+def latest_checkpoint(model_dir):
+    """Return the newest step-numbered checkpoint dir under ``model_dir``
+    (the reference leaned on ``tf.train.latest_checkpoint``,
+    pipeline.py:541-544)."""
+    model_dir = os.path.abspath(os.path.expanduser(model_dir))
+    if not os.path.isdir(model_dir):
+        return None
+    steps = []
+    for name in os.listdir(model_dir):
+        sub = os.path.join(model_dir, name)
+        if os.path.isdir(sub):
+            tail = name.rsplit("_", 1)[-1]
+            if tail.isdigit():
+                steps.append((int(tail), sub))
+    return max(steps)[1] if steps else None
+
+
+def export_saved_model(model_dir, export_dir, state, is_chief=True):
+    """Export final params for serving/inference.
+
+    The orbax checkpoint *is* the exchange format (params restore anywhere,
+    including CPU inference executors); ``is_chief`` is accepted for reference
+    API parity (compat.py:10-17) but all hosts participate in a distributed
+    save.
+    """
+    del model_dir  # kept for signature parity with the reference
+    return save_checkpoint(export_dir, state)

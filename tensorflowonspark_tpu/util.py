@@ -1,0 +1,114 @@
+"""Small host-side utilities shared by driver and executors.
+
+Capability-parity with /root/reference/tensorflowonspark/util.py (IP discovery,
+PATH search, executor-id persistence, single-node env setup) but adapted for the
+jax/TPU runtime: ``single_node_env`` prepares a jax process instead of a TF one,
+and the executor-id file also records the local IPC manager address so later
+Spark tasks landing on the same executor can reconnect to the running jax
+process (reference: util.py:77-86 + TFSparkNode.py:97-123).
+"""
+
+import errno
+import json
+import logging
+import os
+import socket
+
+logger = logging.getLogger(__name__)
+
+# Name of the per-executor state file written into the executor's CWD.
+EXECUTOR_STATE_FILE = "tos_tpu_executor.json"
+
+
+def get_ip_address():
+    """Best-effort routable IP address of this host.
+
+    Uses the UDP-connect trick (no packet is actually sent, so it works in
+    zero-egress environments), falling back to hostname resolution and finally
+    loopback. Reference: util.py:52.
+    """
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 53))
+            return s.getsockname()[0]
+    except OSError:
+        pass
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def find_in_path(path, file_name):
+    """Find a file within a ':'-separated search path (reference util.py:68)."""
+    for p in path.split(os.pathsep):
+        candidate = os.path.join(p, file_name)
+        if os.path.exists(candidate) and os.path.isfile(candidate):
+            return candidate
+    return False
+
+
+def write_executor_state(state, cwd=None):
+    """Persist per-executor bootstrap state (executor id, IPC manager address,
+    authkey) to a file in the executor's working directory.
+
+    The reference persisted just the executor id (util.py:77-82); we persist the
+    whole reconnect record because feeding tasks scheduled later onto this
+    executor must find the already-running jax process's IPC manager.
+    ``authkey`` bytes are hex-encoded.
+    """
+    record = dict(state)
+    if isinstance(record.get("authkey"), bytes):
+        record["authkey"] = record["authkey"].hex()
+        record["authkey_hex"] = True
+    path = os.path.join(cwd or os.getcwd(), EXECUTOR_STATE_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_executor_state(cwd=None):
+    """Read the record written by :func:`write_executor_state`, or None."""
+    path = os.path.join(cwd or os.getcwd(), EXECUTOR_STATE_FILE)
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except OSError as e:
+        if e.errno in (errno.ENOENT,):
+            return None
+        raise
+    if record.pop("authkey_hex", False):
+        record["authkey"] = bytes.fromhex(record["authkey"])
+    return record
+
+
+def single_node_env(num_cpu_devices=None, platform=None):
+    """Prepare the environment for a *single-node* jax process.
+
+    The reference's version wired up the Hadoop classpath and CUDA_VISIBLE_DEVICES
+    (util.py:21-49); the TPU-native analogue selects the jax platform and,
+    for CPU-backed tests, a virtual device count — this must run before jax is
+    imported in the process.
+    """
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+    if num_cpu_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        opt = "--xla_force_host_platform_device_count={}".format(num_cpu_devices)
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " " + opt).strip()
+
+
+def find_free_port(host=""):
+    """Bind-and-release a TCP port; used for coordinator/profiler ports.
+
+    The reference bound a free port for the TF grpc server
+    (TFSparkNode.py:252-255); here ports are needed for the jax.distributed
+    coordinator and the profiler server.
+    """
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]

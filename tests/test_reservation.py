@@ -1,0 +1,130 @@
+"""Control-plane tests, cluster-free (mirrors reference test/test_reservation.py)."""
+
+import os
+import threading
+import time
+from unittest import mock
+
+import pytest
+
+from tensorflowonspark_tpu import reservation
+
+
+class TestReservations:
+    def test_counting(self):
+        store = reservation.Reservations(3)
+        assert store.remaining() == 3
+        assert not store.done
+        store.add({"node": 0})
+        store.add({"node": 1})
+        assert store.remaining() == 1
+        store.add({"node": 2})
+        assert store.done
+        assert len(store.get()) == 3
+
+    def test_wait_timeout(self):
+        store = reservation.Reservations(1)
+        assert not store.wait(timeout=0.1)
+        store.add({"node": 0})
+        assert store.wait(timeout=0.1)
+
+
+class TestServerClient:
+    def test_register_query_info_stop(self):
+        server = reservation.Server(2)
+        addr = server.start()
+        try:
+            client = reservation.Client(addr)
+            assert client.get_reservations() == []
+            client.register({"host": "a", "executor_id": 0})
+            client.register({"host": "b", "executor_id": 1})
+            info = client.await_reservations(timeout=5)
+            assert {r["host"] for r in info} == {"a", "b"}
+            assert not client.stop_requested()
+            client.request_stop()
+            assert client.stop_requested()
+            assert server.stop_requested
+        finally:
+            server.stop()
+
+    def test_driver_await_aborts_on_node_error(self):
+        server = reservation.Server(2)
+        server.start()
+        try:
+            status = {}
+
+            def fail_soon():
+                time.sleep(0.2)
+                status["error"] = "boom on executor 1"
+
+            threading.Thread(target=fail_soon, daemon=True).start()
+            with pytest.raises(reservation.ReservationError, match="boom"):
+                server.await_reservations(status=status, timeout=10, poll_interval=0.05)
+        finally:
+            server.stop()
+
+    def test_driver_await_times_out(self):
+        server = reservation.Server(2)
+        server.start()
+        try:
+            with pytest.raises(reservation.ReservationError, match="timed out"):
+                server.await_reservations(timeout=0.3, poll_interval=0.05)
+        finally:
+            server.stop()
+
+    def test_env_overrides(self):
+        with mock.patch.dict(os.environ, {reservation.ENV_SERVER_HOST: "visible.example"}):
+            server = reservation.Server(1)
+            host, port = server.start()
+            try:
+                assert host == "visible.example"
+                assert port > 0
+            finally:
+                server.stop()
+
+    def test_concurrent_clients(self):
+        n = 4
+        server = reservation.Server(n)
+        addr = server.start()
+        try:
+            def reserve(i):
+                c = reservation.Client(addr)
+                c.register({"executor_id": i})
+                c.await_reservations(timeout=10, poll_interval=0.05)
+
+            threads = [threading.Thread(target=reserve, args=(i,)) for i in range(n)]
+            for t in threads:
+                t.start()
+            got = server.await_reservations(timeout=10, poll_interval=0.05)
+            for t in threads:
+                t.join(timeout=10)
+            assert sorted(r["executor_id"] for r in got) == list(range(n))
+        finally:
+            server.stop()
+
+
+class TestIdempotentRegister:
+    def test_duplicate_executor_id_replaces(self):
+        store = reservation.Reservations(2)
+        store.add({"executor_id": 0, "v": 1})
+        store.add({"executor_id": 0, "v": 2})  # retried REG
+        assert not store.done
+        assert store.get() == [{"executor_id": 0, "v": 2}]
+        store.add({"executor_id": 1, "v": 1})
+        assert store.done
+
+    def test_non_object_json_does_not_kill_server(self):
+        import socket as _socket
+        import struct as _struct
+
+        server = reservation.Server(1)
+        _host, port = server.start()
+        try:
+            payload = b"123"
+            with _socket.create_connection(("127.0.0.1", port)) as s:
+                s.sendall(_struct.pack(">I", len(payload)) + payload)
+            c = reservation.Client(("127.0.0.1", port))
+            c.register({"executor_id": 0})
+            assert server.reservations.done
+        finally:
+            server.stop()

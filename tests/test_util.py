@@ -1,0 +1,34 @@
+import os
+
+from tensorflowonspark_tpu import util
+
+
+def test_ip_address_is_string():
+    ip = util.get_ip_address()
+    assert isinstance(ip, str) and ip.count(".") == 3
+
+
+def test_find_in_path(tmp_path):
+    f = tmp_path / "tool"
+    f.write_text("x")
+    path = os.pathsep.join(["/nonexistent", str(tmp_path)])
+    assert util.find_in_path(path, "tool") == str(f)
+    assert util.find_in_path(path, "missing") is False
+
+
+def test_executor_state_roundtrip(tmp_path):
+    state = {"executor_id": 3, "address": ["10.0.0.1", 4000], "authkey": b"\x01\x02"}
+    util.write_executor_state(state, cwd=str(tmp_path))
+    got = util.read_executor_state(cwd=str(tmp_path))
+    assert got["executor_id"] == 3
+    assert got["address"] == ["10.0.0.1", 4000]
+    assert got["authkey"] == b"\x01\x02"
+
+
+def test_read_executor_state_missing(tmp_path):
+    assert util.read_executor_state(cwd=str(tmp_path)) is None
+
+
+def test_find_free_port():
+    p = util.find_free_port()
+    assert 0 < p < 65536
