@@ -1,0 +1,297 @@
+"""Driver-side cluster lifecycle API.
+
+Capability-parity with /root/reference/tensorflowonspark/TFCluster.py: validate
+the cluster template, start the reservation server, launch one node per
+executor through the execution backend, block until the cluster assembles, and
+expose ``train`` / ``inference`` / ``shutdown``.
+
+TPU-native differences (SURVEY.md §7):
+
+* the assembled reservations define a **jax.distributed world** (coordinator
+  address + process ids) instead of a TF ClusterSpec/TF_CONFIG;
+* ``ps`` nodes are accepted for API compatibility but do no training work —
+  sync data parallelism over ICI replaces both MultiWorkerMirroredStrategy and
+  ParameterServerStrategy (SURVEY.md §2.6);
+* works against a real ``pyspark.SparkContext`` or the bundled local
+  multi-process backend (:mod:`tensorflowonspark_tpu.backends.local`).
+"""
+
+import logging
+import random
+import secrets
+import threading
+
+from tensorflowonspark_tpu import TFSparkNode, TFManager, reservation
+
+logger = logging.getLogger(__name__)
+
+
+class InputMode:
+    """How the training program ingests data (reference TFCluster.py:43-49)."""
+
+    TENSORFLOW = 0  #: user code reads its own data (GCS/HDFS/tfds) — perf path
+    SPARK = 1  #: Spark partitions stream through the executor feed queues
+
+
+class TFCluster:
+    """Handle to a running cluster; constructed by :func:`run`."""
+
+    def __init__(self, sc, cluster_info, cluster_meta, input_mode, server, launch_thread, tf_status, num_workers, worker_executor_ids):
+        self.sc = sc
+        self.cluster_info = cluster_info
+        self.cluster_meta = cluster_meta
+        self.input_mode = input_mode
+        self.server = server
+        self.launch_thread = launch_thread
+        self.tf_status = tf_status
+        self.num_workers = num_workers
+        self.worker_executor_ids = worker_executor_ids
+        self.queues = cluster_meta["queues"]
+
+    # -- data plane -----------------------------------------------------------
+
+    def train(self, dataRDD, num_epochs=0, feed_timeout=600, qname="input"):
+        """Feed an RDD to the cluster for training (InputMode.SPARK only);
+        blocks until the data is consumed or training requests a stop
+        (reference TFCluster.py:63-94)."""
+        logger.info("feeding training data (epochs=%s)", num_epochs)
+        assert self.input_mode == InputMode.SPARK, "train() requires InputMode.SPARK"
+        assert dataRDD is not None, "dataRDD is required"
+        rdd = dataRDD
+        if num_epochs and num_epochs > 1:
+            rdd = self.sc.union([dataRDD] * num_epochs)
+        rdd.foreachPartition(
+            TFSparkNode.train(self.cluster_info, self.cluster_meta, feed_timeout=feed_timeout, qname=qname)
+        )
+
+    def inference(self, dataRDD, feed_timeout=600, qname="input", qname_out="output"):
+        """Feed an RDD for inference; returns a (lazy) RDD of results with a
+        1:1 input:output contract (reference TFCluster.py:96-115)."""
+        assert self.input_mode == InputMode.SPARK, "inference() requires InputMode.SPARK"
+        assert dataRDD is not None, "dataRDD is required"
+        return dataRDD.mapPartitions(
+            TFSparkNode.inference(
+                self.cluster_info, self.cluster_meta, feed_timeout=feed_timeout,
+                qname=qname, qname_out=qname_out,
+            )
+        )
+
+    # -- teardown -------------------------------------------------------------
+
+    def shutdown(self, ssc=None, grace_secs=0, timeout=259200):
+        """Stop the cluster: end-of-feed to every worker, wait for the launch
+        job, stop driver-managed roles, surface any node error
+        (reference TFCluster.py:117-202; the 3-day default timeout mirrors
+        its SIGALRM watchdog, TFCluster.py:136-144)."""
+        logger.info("shutting down cluster")
+        del ssc  # streaming handled at a higher layer
+
+        if self.input_mode == InputMode.SPARK:
+            self._shutdown_workers(grace_secs)
+
+        # driver-managed roles: post None on their remote control queues
+        # (reference TFCluster.py:188-194)
+        for row in self.cluster_info:
+            if row.get("manager_addr"):
+                try:
+                    mgr = TFManager.connect(tuple(row["manager_addr"]), self.cluster_meta["authkey"])
+                    mgr.get_queue("control").put(None, block=True)
+                except Exception as e:
+                    logger.warning(
+                        "could not stop %s:%s at %s: %s",
+                        row["job_name"], row["task_index"], row["manager_addr"], e,
+                    )
+
+        self.launch_thread.join(timeout=timeout)
+        if self.launch_thread.is_alive():
+            raise RuntimeError("cluster did not shut down within {}s".format(timeout))
+        self.server.stop()
+        if self.tf_status.get("error"):
+            raise RuntimeError("cluster failed: {}".format(self.tf_status["error"]))
+        logger.info("cluster shut down cleanly")
+
+    def _shutdown_workers(self, grace_secs):
+        """Post end-of-feed directly to every worker's queues over its TCP
+        channel and wait for each jax child to wind down.
+
+        Deterministic replacement for the reference's shutdown-by-Spark-tasks
+        (TFCluster.py:174-176 + TFSparkNode.py:534-588), which relied on the
+        scheduler spreading exactly one quick task per executor; here every
+        worker is addressed explicitly, so no node can miss (or double-get)
+        its end-of-feed marker.
+        """
+        import time
+
+        workers = [
+            r for r in self.cluster_info
+            if r["job_name"] in ("chief", "master", "worker") and r.get("manager_addr")
+        ]
+        channels = []
+        for row in workers:
+            try:
+                mgr = TFManager.connect(tuple(row["manager_addr"]), self.cluster_meta["authkey"])
+                mgr.get_queue("input").put(None, block=True)
+                channels.append((row, mgr))
+            except Exception as e:
+                logger.warning(
+                    "could not reach %s:%s for shutdown: %s", row["job_name"], row["task_index"], e
+                )
+        errors = []
+        deadline = time.time() + max(grace_secs, 60) + grace_secs
+        for row, mgr in channels:
+            while True:
+                status = mgr.get("child_status")
+                if status is not None or time.time() > deadline:
+                    break
+                time.sleep(0.1)
+            try:
+                eq = mgr.get_queue("error")
+                if not eq.empty():
+                    tb = eq.get(block=False)
+                    eq.put(tb)  # keep visible (reference peek-and-requeue,
+                    eq.task_done()  # TFSparkNode.py:576-582)
+                    errors.append("node {}:{}:\n{}".format(row["job_name"], row["task_index"], tb))
+            except Exception:
+                pass
+            mgr.set("state", "stopped")
+        if errors:
+            raise RuntimeError("error(s) in cluster nodes:\n" + "\n".join(errors))
+
+    # -- observability --------------------------------------------------------
+
+    def tensorboard_url(self):
+        """URL of the profiler/TensorBoard server on the chief, if one was
+        launched (reference TFCluster.py:204-209)."""
+        for row in self.cluster_info:
+            if row.get("tb_port"):
+                return "http://{}:{}".format(row["host"], row["tb_port"])
+        return None
+
+
+def build_cluster_template(num_executors, num_ps=0, master_node="chief", eval_node=False):
+    """executor_id → (job_name, task_index), in the reference's role order
+    ps → chief → evaluator → worker (TFCluster.py:252-267)."""
+    roles = ["ps"] * num_ps
+    if master_node:
+        roles.append(master_node)
+    if eval_node:
+        roles.append("evaluator")
+    num_workers = num_executors - len(roles)
+    if num_workers < 0 or (num_workers == 0 and not master_node):
+        raise ValueError(
+            "num_executors={} too small for num_ps={}, master_node={!r}, eval_node={}".format(
+                num_executors, num_ps, master_node, eval_node
+            )
+        )
+    roles.extend(["worker"] * num_workers)
+    template, counters = {}, {}
+    for executor_id, job in enumerate(roles):
+        task_index = counters.get(job, 0)
+        counters[job] = task_index + 1
+        template[executor_id] = (job, task_index)
+    return template
+
+
+def run(
+    sc,
+    map_fun,
+    tf_args,
+    num_executors,
+    num_ps=0,
+    tensorboard=False,
+    input_mode=InputMode.SPARK,
+    log_dir=None,
+    driver_ps_nodes=False,
+    master_node="chief",
+    reservation_timeout=600,
+    queues=None,
+    eval_node=False,
+    env=None,
+    jax_distributed=None,
+):
+    """Start a cluster: one node per executor (reference TFCluster.py:212-380).
+
+    ``env`` is propagated into every jax child process (e.g.
+    ``{"JAX_PLATFORMS": "cpu"}`` for CPU test runs). ``jax_distributed``
+    controls whether children join a multi-process jax world; default: only
+    when more than one training participant exists and no explicit override.
+    """
+    if driver_ps_nodes:
+        raise NotImplementedError(
+            "driver_ps_nodes: parameter servers have no TPU analogue; ps roles "
+            "run on executors for API compatibility only (SURVEY.md §2.6)"
+        )
+    template = build_cluster_template(num_executors, num_ps, master_node, eval_node)
+    num_workers = sum(1 for job, _ in template.values() if job in ("chief", "master", "worker"))
+    worker_executor_ids = [
+        eid for eid, (job, _) in template.items() if job in ("chief", "master", "worker")
+    ]
+    if jax_distributed is None:
+        jax_distributed = num_workers > 1 and not (env or {}).get("JAX_PLATFORMS") == "cpu"
+    logger.info("cluster template: %s", {e: "{}:{}".format(j, t) for e, (j, t) in template.items()})
+
+    server = reservation.Server(num_executors)
+    server_addr = server.start()
+
+    default_fs = getattr(sc, "defaultFS", None)
+    if default_fs is None:
+        try:  # real pyspark: ask the Hadoop conf
+            default_fs = sc._jsc.hadoopConfiguration().get("fs.defaultFS")
+        except Exception:
+            default_fs = "file://"
+
+    cluster_meta = {
+        "id": random.getrandbits(64),
+        "cluster_template": template,
+        "num_executors": num_executors,
+        "server_addr": server_addr,
+        "default_fs": default_fs,
+        "queues": list(queues or TFManager.CONTROL_QUEUES),
+        "input_mode": "spark" if input_mode == InputMode.SPARK else "tensorflow",
+        "authkey": secrets.token_bytes(16),
+        "reservation_timeout": reservation_timeout,
+        "env": dict(env or {}),
+        "jax_distributed": bool(jax_distributed),
+        "tensorboard": bool(tensorboard),
+        "log_dir": log_dir,
+    }
+
+    tf_status = {}
+    kwargs = (
+        {"pin_to_executors": True} if getattr(sc, "PIN_SUPPORTED", False) else {}
+    )
+    node_rdd = sc.parallelize(range(num_executors), num_executors, **kwargs)
+    launch_task = TFSparkNode.run(
+        map_fun, tf_args, cluster_meta, cluster_meta["input_mode"], log_dir, cluster_meta["queues"]
+    )
+
+    def _start():
+        try:
+            node_rdd.foreachPartition(launch_task)
+        except Exception as e:
+            logger.error("node launch failed: %s", e)
+            tf_status["error"] = str(e)
+
+    launch_thread = threading.Thread(target=_start, name="tos-cluster-launch", daemon=True)
+    launch_thread.start()
+
+    cluster_info = server.await_reservations(tf_status, timeout=reservation_timeout)
+
+    # duplicate-node sanity check (reference TFCluster.py:352-367)
+    eids = [r["executor_id"] for r in cluster_info]
+    if sorted(eids) != sorted(template.keys()):
+        raise RuntimeError(
+            "cluster assembled with wrong executor set: got {} expected {}".format(
+                sorted(eids), sorted(template.keys())
+            )
+        )
+    for row in sorted(cluster_info, key=lambda r: r["executor_id"]):
+        logger.info(
+            "node: executor=%d %s:%d @ %s:%s chips=%s",
+            row["executor_id"], row["job_name"], row["task_index"],
+            row["host"], row["port"], (row.get("tpu") or {}).get("num_chips"),
+        )
+    return TFCluster(
+        sc, cluster_info, cluster_meta, input_mode, server, launch_thread, tf_status,
+        num_workers, worker_executor_ids,
+    )

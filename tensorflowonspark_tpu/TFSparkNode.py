@@ -1,0 +1,577 @@
+"""Executor-side node runtime.
+
+Capability-parity with /root/reference/tensorflowonspark/TFSparkNode.py, built
+for the TPU process model. Per executor, the launch task:
+
+1. maps its executor id to a (job_name, task_index) from the cluster template,
+2. starts the per-executor IPC channel (local unix socket; TCP for
+   driver-managed roles) and persists the reconnect record to the executor CWD,
+3. registers with the driver's reservation server (host, coordinator port, TPU
+   topology) and blocks until the whole cluster is assembled,
+4. derives the jax.distributed world — coordinator address, process count,
+   process id — from the assembled cluster info (the ClusterSpec/TF_CONFIG
+   analogue, reference TFSparkNode.py:277-299),
+5. forks the **jax child process** that owns this host's TPU chips and runs the
+   user's ``main_fun(args, ctx)``; the executor process itself never imports
+   jax, so it stays light and reusable across Spark tasks (the reference's
+   bg-process dispatch, TFSparkNode.py:339-395, generalized: on TPU *every*
+   role runs in a child so libtpu's process-owns-chips rule is respected and
+   chips are freed when the child exits).
+
+Feeding/inference/shutdown closures are picklable task objects (Spark and the
+local backend both ship them to executors by serialization).
+"""
+
+import logging
+import os
+import time
+import traceback
+
+from tensorflowonspark_tpu import TFManager, TFNode, reservation, tpu_info, util
+from tensorflowonspark_tpu.marker import EndPartition
+
+logger = logging.getLogger(__name__)
+
+_mp = __import__("multiprocessing").get_context("fork")
+
+#: Executor-process-global registry of live IPC channels, keyed by executor id.
+#: Keeps the manager server process alive after the launch task returns (its
+#: BaseManager finalizer would otherwise tear the channel down) and lets tasks
+#: that land on this executor later reuse the handle — the reference's
+#: module-global manager singleton (TFSparkNode.py:97-123).
+_live_channels = {}
+
+
+class TFNodeContext:
+    """Context object handed to user ``main_fun(args, ctx)``.
+
+    Field-parity with the reference's ctx (TFSparkNode.py:37-60: job_name,
+    task_index, cluster_spec, defaultFS, working_dir, mgr, num_workers) plus
+    the TPU world: coordinator address / process id / process count for
+    ``jax.distributed``, and the local chip topology.
+    """
+
+    def __init__(
+        self,
+        executor_id,
+        job_name,
+        task_index,
+        cluster_spec,
+        defaultFS,
+        working_dir,
+        mgr=None,
+        coordinator_address=None,
+        num_processes=1,
+        process_id=0,
+        topology=None,
+        cluster_meta=None,
+    ):
+        self.executor_id = executor_id
+        self.worker_num = executor_id  # reference-compat alias
+        self.job_name = job_name
+        self.task_index = task_index
+        self.cluster_spec = cluster_spec
+        self.defaultFS = defaultFS
+        self.working_dir = working_dir
+        self.mgr = mgr
+        self.coordinator_address = coordinator_address
+        self.num_processes = num_processes
+        self.process_id = process_id
+        self.topology = topology or {}
+        self.cluster_meta = cluster_meta or {}
+
+    @property
+    def num_workers(self):
+        """Number of training participants (chief/master + workers), reference
+        TFSparkNode.py:58."""
+        spec = self.cluster_spec or {}
+        return (
+            len(spec.get("chief", []))
+            + len(spec.get("master", []))
+            + len(spec.get("worker", []))
+        )
+
+    @property
+    def distributed(self):
+        return self.num_processes > 1
+
+    def get_data_feed(self, train_mode=True, qname_in="input", qname_out="output", input_mapping=None):
+        """The InputMode.SPARK consumer (reference TFNode.py:221)."""
+        return TFNode.DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping)
+
+    def absolute_path(self, path):
+        return TFNode.hdfs_path(self, path)
+
+    def initialize_distributed(self):
+        """Join the jax.distributed world derived from the reservations.
+
+        Call before any other jax API in multi-host runs; no-op single-host.
+        This is the TF_CONFIG/ClusterSpec replacement (SURVEY.md §2.8).
+        """
+        if self.num_processes <= 1:
+            return
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator_address,
+            num_processes=self.num_processes,
+            process_id=self.process_id,
+        )
+
+    def mesh(self, axes=None):
+        """Construct the device mesh for this cluster (convenience wrapper
+        around :mod:`tensorflowonspark_tpu.parallel.mesh`)."""
+        from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+
+        return mesh_lib.build_mesh(axes)
+
+
+def _role_rank(job_name):
+    # template order mirrors the reference: ps → chief → evaluator → worker
+    return {"ps": 0, "chief": 1, "master": 1, "evaluator": 2, "worker": 3}.get(job_name, 3)
+
+
+def _participants(cluster_info):
+    """Training participants (chief first, then workers by task_index)."""
+    rows = [r for r in cluster_info if r["job_name"] in ("chief", "master", "worker")]
+    return sorted(rows, key=lambda r: (0 if r["job_name"] in ("chief", "master") else 1, r["task_index"]))
+
+
+def _derive_world(cluster_info, me):
+    """coordinator address + (num_processes, process_id) for this node.
+
+    ps/evaluator roles are outside the collective world (no PS on TPU —
+    SURVEY.md §2.6: capability met by sync DP over ICI); they get a
+    single-process world so ``initialize_distributed`` no-ops.
+    """
+    parts = _participants(cluster_info)
+    if not parts:
+        return None, 1, 0
+    coord = "{}:{}".format(parts[0]["host"], parts[0]["port"])
+    for i, row in enumerate(parts):
+        if row["executor_id"] == me["executor_id"]:
+            return coord, len(parts), i
+    return None, 1, 0
+
+
+def _child_entry(fn, tf_args, ctx, cluster_meta, error_queue_spec):
+    """Entry point of the jax child process: applies env, joins the
+    distributed world, runs the user fn; failures land on the 'error' queue
+    (reference wrapper_fn_background, TFSparkNode.py:355-361)."""
+    try:
+        env = cluster_meta.get("env") or {}
+        os.environ.update(env)
+        os.environ.update(tpu_info.visibility_env(platform=env.get("JAX_PLATFORMS")))
+        # re-connect our own IPC channel from inside the child
+        addr, authkey = error_queue_spec
+        ctx.mgr = TFManager.connect(addr, authkey)
+        if cluster_meta.get("jax_distributed", True):
+            ctx.initialize_distributed()
+        if cluster_meta.get("log_dir") and ctx.process_id == 0:
+            try:
+                import jax
+
+                profiler_port = util.find_free_port()
+                jax.profiler.start_server(profiler_port)
+                logger.info("jax profiler server on port %d", profiler_port)
+            except Exception as e:  # profiling is best-effort
+                logger.warning("could not start jax profiler server: %s", e)
+        fn(tf_args, ctx)
+        ctx.mgr.set("child_status", "done")
+    except BaseException:
+        tb = traceback.format_exc()
+        logger.error("user main_fun failed:\n%s", tb)
+        try:
+            addr, authkey = error_queue_spec
+            mgr = TFManager.connect(addr, authkey)
+            mgr.get_queue("error").put(tb)
+            mgr.set("child_status", "failed")
+        except Exception:
+            pass
+        raise SystemExit(1)
+
+
+class _NodeLaunchTask:
+    """The ``foreachPartition`` closure that boots one cluster node
+    (reference ``TFSparkNode.run()._mapfn``, TFSparkNode.py:126-395)."""
+
+    def __init__(self, fn, tf_args, cluster_meta, input_mode, log_dir=None, queues=None):
+        self.fn = fn
+        self.tf_args = tf_args
+        self.cluster_meta = cluster_meta
+        self.input_mode = input_mode
+        self.log_dir = log_dir
+        self.queues = tuple(queues or TFManager.CONTROL_QUEUES)
+
+    def __call__(self, iterator):
+        executor_id = None
+        for i in iterator:
+            executor_id = i
+        if executor_id is None:
+            return []
+        meta = self.cluster_meta
+
+        # Detect a live node from a previous (failed or duplicate) launch on
+        # this executor: raising forces the scheduler to retry elsewhere
+        # (reference TFSparkNode.py:173-179).
+        prior = util.read_executor_state()
+        if prior is not None:
+            try:
+                old = TFManager.connect(prior["address"], prior["authkey"])
+                if old.get("state") in ("running", "terminating"):
+                    raise RuntimeError(
+                        "executor already hosts a live node for cluster {} — "
+                        "forcing task retry on another executor".format(prior.get("cluster_id"))
+                    )
+            except RuntimeError:
+                raise
+            except Exception:
+                pass  # stale record from a dead process: overwrite
+
+        template = meta["cluster_template"]
+        job_name, task_index = template[executor_id]
+        authkey = meta["authkey"]
+        # every channel is TCP ('remote'): the driver shuts nodes down by
+        # posting end-of-feed directly to each node's queues — deterministic,
+        # unlike scattering shutdown tasks and hoping the scheduler spreads
+        # them one-per-executor (the reference's approach, TFCluster.py:174).
+        mgr = TFManager.start(authkey=authkey, queues=self.queues, mode="remote")
+        old = _live_channels.pop(executor_id, None)
+        if old is not None:
+            old.shutdown()  # previous cluster's channel on a reused executor
+        _live_channels[executor_id] = mgr  # pin the channel beyond this task
+        mgr.set("state", "starting")
+
+        host = util.get_ip_address()
+        port = util.find_free_port()
+        is_tb_node = job_name in ("chief", "master") or (
+            "chief" not in {j for j, _ in template.values()}
+            and "master" not in {j for j, _ in template.values()}
+            and job_name == "worker"
+            and task_index == 0
+        )
+        tb_port = None
+        if meta.get("tensorboard") and is_tb_node:
+            tb_port = self._launch_tensorboard(meta.get("log_dir"))
+        client = reservation.Client(meta["server_addr"])
+        client.register(
+            {
+                "executor_id": executor_id,
+                "host": host,
+                "job_name": job_name,
+                "task_index": task_index,
+                "port": port,
+                "manager_addr": list(mgr.address),
+                "tb_port": tb_port,
+                "tpu": tpu_info.local_topology(),
+            }
+        )
+        cluster_info = client.await_reservations(timeout=meta.get("reservation_timeout", 600))
+
+        # sanity: every executor id distinct (reference TFSparkNode.py:281-289)
+        ids = [r["executor_id"] for r in cluster_info]
+        if len(set(ids)) != len(ids):
+            raise RuntimeError("duplicate executor ids in cluster: {}".format(sorted(ids)))
+
+        cluster_spec = {}
+        for row in sorted(cluster_info, key=lambda r: (_role_rank(r["job_name"]), r["task_index"])):
+            cluster_spec.setdefault(row["job_name"], []).append(
+                "{}:{}".format(row["host"], row["port"])
+            )
+        me = {"executor_id": executor_id}
+        coord, num_procs, proc_id = _derive_world(cluster_info, me)
+
+        util.write_executor_state(
+            {
+                "executor_id": executor_id,
+                "cluster_id": meta["id"],
+                "address": mgr.address,
+                "authkey": authkey,
+                "job_name": job_name,
+                "task_index": task_index,
+            }
+        )
+
+        ctx = TFNodeContext(
+            executor_id=executor_id,
+            job_name=job_name,
+            task_index=task_index,
+            cluster_spec=cluster_spec,
+            defaultFS=meta.get("default_fs", "file://"),
+            working_dir=os.getcwd(),
+            mgr=None,  # child re-connects its own handle
+            coordinator_address=coord,
+            num_processes=num_procs if meta.get("jax_distributed", False) else 1,
+            process_id=proc_id,
+            topology=tpu_info.local_topology(),
+            cluster_meta={k: meta[k] for k in ("id", "server_addr", "input_mode") if k in meta},
+        )
+        mgr.set("state", "running")
+        logger.info(
+            "node %s:%d (executor %d) up; world=%s procs=%d id=%d",
+            job_name, task_index, executor_id, coord, num_procs, proc_id,
+        )
+
+        child = _mp.Process(
+            target=_child_entry,
+            args=(self.fn, self.tf_args, ctx, meta, (mgr.address, authkey)),
+            name="jax-node-{}-{}".format(job_name, task_index),
+        )
+        child.start()
+        self._register_child(child)
+
+        if job_name in ("ps", "evaluator"):
+            # park until the driver posts a shutdown message on the control
+            # queue (reference ps wait loop, TFSparkNode.py:373-390)
+            control = mgr.get_queue("control")
+            while True:
+                msg = control.get(block=True)
+                control.task_done()
+                if msg is None:
+                    break
+            child.terminate()
+            child.join(timeout=10)
+            mgr.set("state", "stopped")
+        elif self.input_mode == "spark":
+            # return immediately: this executor's slot is needed for feed tasks
+            pass
+        else:
+            # InputMode.TENSORFLOW: the task occupies the slot until training
+            # finishes (reference fg-thread dispatch, TFSparkNode.py:391-395)
+            child.join()
+            mgr.set("state", "stopped")
+            if child.exitcode != 0:
+                err = None
+                try:
+                    eq = mgr.get_queue("error")
+                    if not eq.empty():
+                        err = eq.get(block=False)
+                        eq.task_done()
+                except Exception:
+                    pass
+                raise RuntimeError(
+                    "node {}:{} failed (exit {}):\n{}".format(
+                        job_name, task_index, child.exitcode, err or "<no traceback captured>"
+                    )
+                )
+        return []
+
+    @staticmethod
+    def _register_child(proc):
+        try:
+            from tensorflowonspark_tpu.backends import local as local_backend
+
+            local_backend.register_child_process(proc)
+        except Exception:
+            pass
+
+    def _launch_tensorboard(self, log_dir):
+        """Launch a TensorBoard subprocess on this (chief) executor if the
+        binary is available (reference TFSparkNode.py:206-238). Returns the
+        port or None. The jax child additionally serves profiler data into
+        ``log_dir`` via jax.profiler."""
+        import subprocess
+        import sys
+
+        port = util.find_free_port()
+        cmd = [
+            sys.executable, "-m", "tensorboard.main",
+            "--logdir", log_dir or os.getcwd(),
+            "--host", "0.0.0.0", "--port", str(port),
+        ]
+        try:
+            proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        except OSError as e:
+            logger.warning("could not launch tensorboard: %s", e)
+            return None
+        self._register_child(_PopenAdapter(proc))
+        logger.info("tensorboard listening on port %d (logdir=%s)", port, log_dir)
+        return port
+
+
+class _PopenAdapter:
+    """Adapts subprocess.Popen to the mp.Process reaping surface the local
+    backend expects (is_alive/terminate/join)."""
+
+    def __init__(self, popen):
+        self._p = popen
+
+    def is_alive(self):
+        return self._p.poll() is None
+
+    def terminate(self):
+        self._p.terminate()
+
+    def join(self, timeout=None):
+        try:
+            self._p.wait(timeout=timeout)
+        except Exception:
+            pass
+
+
+def _connect_executor_channel():
+    state = util.read_executor_state()
+    if state is not None and state.get("executor_id") in _live_channels:
+        return state, _live_channels[state["executor_id"]]
+    if state is None:
+        raise RuntimeError(
+            "no cluster node on this executor (missing {} in {}) — was the "
+            "cluster started, and is this task on a cluster executor?".format(
+                util.EXECUTOR_STATE_FILE, os.getcwd()
+            )
+        )
+    return state, TFManager.connect(state["address"], state["authkey"])
+
+
+def _raise_if_remote_error(mgr):
+    eq = mgr.get_queue("error")
+    if not eq.empty():
+        try:
+            tb = eq.get(block=False)
+        except Exception:
+            return
+        # keep the error visible to later tasks too (reference peek-and-requeue
+        # trick, TFSparkNode.py:576-582)
+        eq.put(tb)
+        eq.task_done()
+        raise RuntimeError("error in jax child process:\n{}".format(tb))
+
+
+class _TrainPartitionTask:
+    """Feeds one RDD partition into the executor's input queue
+    (reference ``TFSparkNode.train()._train``, TFSparkNode.py:400-467)."""
+
+    def __init__(self, cluster_meta, qname="input", feed_timeout=600):
+        self.cluster_meta = cluster_meta
+        self.qname = qname
+        self.feed_timeout = feed_timeout
+
+    def __call__(self, iterator):
+        _state, mgr = _connect_executor_channel()
+        if mgr.get("state") == "terminating":
+            logger.info("node is terminating; skipping partition")
+            for _ in iterator:  # drain so the scheduler sees the task consumed
+                pass
+            return []
+        q = mgr.get_queue(self.qname)
+        count = 0
+        for item in iterator:
+            q.put(item, block=True)
+            count += 1
+        logger.info("fed %d items to queue %r; waiting for consumption", count, self.qname)
+        deadline = time.time() + self.feed_timeout
+        while q.unfinished() > 0:
+            _raise_if_remote_error(mgr)
+            if mgr.get("state") == "terminating":
+                break
+            if time.time() > deadline:
+                raise RuntimeError(
+                    "feed timeout: queue {!r} still has {} unconsumed items".format(
+                        self.qname, q.unfinished()
+                    )
+                )
+            time.sleep(0.1)
+        _raise_if_remote_error(mgr)
+        if mgr.get("state") == "terminating":
+            # training said "enough" (e.g. reached target steps): tell the
+            # driver so it can stop scheduling feed jobs
+            # (reference TFSparkNode.py:451-464)
+            try:
+                reservation.Client(self.cluster_meta["server_addr"]).request_stop()
+            except reservation.ReservationError:
+                pass
+        return []
+
+
+class _InferencePartitionTask:
+    """Feeds one partition and collects exactly its results
+    (reference ``TFSparkNode.inference()._inference``, TFSparkNode.py:470-529)."""
+
+    def __init__(self, cluster_meta, qname_in="input", qname_out="output", feed_timeout=600):
+        self.cluster_meta = cluster_meta
+        self.qname_in = qname_in
+        self.qname_out = qname_out
+        self.feed_timeout = feed_timeout
+
+    def __call__(self, iterator):
+        _state, mgr = _connect_executor_channel()
+        q = mgr.get_queue(self.qname_in)
+        count = 0
+        for item in iterator:
+            q.put(item, block=True)
+            count += 1
+        q.put(EndPartition(), block=True)
+        if count == 0:
+            return []
+        deadline = time.time() + self.feed_timeout
+        while q.unfinished() > 0:
+            _raise_if_remote_error(mgr)
+            if time.time() > deadline:
+                raise RuntimeError("inference feed timeout on queue {!r}".format(self.qname_in))
+            time.sleep(0.1)
+        out = mgr.get_queue(self.qname_out)
+        results = []
+        while len(results) < count:
+            results.append(out.get(block=True, timeout=self.feed_timeout))
+            out.task_done()
+        logger.info("collected %d inference results", len(results))
+        return results
+
+
+class _ShutdownPartitionTask:
+    """Posts end-of-feed to one worker's queues and confirms the node wound
+    down (reference ``TFSparkNode.shutdown()._shutdown``, TFSparkNode.py:534-588)."""
+
+    def __init__(self, cluster_meta, queues=("input",), grace_secs=0):
+        self.cluster_meta = cluster_meta
+        self.queues = tuple(queues)
+        self.grace_secs = grace_secs
+
+    def __call__(self, iterator):
+        for _ in iterator:
+            pass
+        _state, mgr = _connect_executor_channel()
+        for qname in self.queues:
+            mgr.get_queue(qname).put(None, block=True)
+        # give the child time to drain + export (reference grace sleep,
+        # TFSparkNode.py:571-574); when we own the child handle (local
+        # backend: launch ran in this very process) join it instead.
+        joined = False
+        try:
+            from tensorflowonspark_tpu.backends import local as local_backend
+
+            for proc in local_backend._executor_children:
+                proc.join(timeout=max(self.grace_secs, 60))
+                joined = True
+        except Exception:
+            pass
+        if not joined and self.grace_secs:
+            time.sleep(self.grace_secs)
+        _raise_if_remote_error(mgr)
+        mgr.set("state", "stopped")
+        return []
+
+
+# -- public factory API (names match the reference) ---------------------------
+
+
+def run(fn, tf_args, cluster_meta, input_mode, log_dir=None, queues=None):
+    """Build the node-launch closure for ``nodeRDD.foreachPartition``."""
+    return _NodeLaunchTask(fn, tf_args, cluster_meta, input_mode, log_dir, queues)
+
+
+def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
+    del cluster_info  # reconnection goes through the executor state file
+    return _TrainPartitionTask(cluster_meta, qname=qname, feed_timeout=feed_timeout)
+
+
+def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input", qname_out="output"):
+    del cluster_info
+    return _InferencePartitionTask(
+        cluster_meta, qname_in=qname, qname_out=qname_out, feed_timeout=feed_timeout
+    )
+
+
+def shutdown(cluster_info, cluster_meta, queues=("input",), grace_secs=0):
+    del cluster_info
+    return _ShutdownPartitionTask(cluster_meta, queues=queues, grace_secs=grace_secs)

@@ -1,0 +1,21 @@
+"""Execution backends.
+
+The framework's driver API is written against the small slice of the Spark
+surface it actually uses (``parallelize``/``union``/``foreachPartition``/
+``mapPartitions``/``collect``). Two backends provide it:
+
+* :mod:`~tensorflowonspark_tpu.backends.local` — a multi-process local
+  "standalone cluster": N long-lived executor processes with one task slot
+  each, the same process topology the reference's test harness built with a
+  2-worker Spark Standalone cluster (reference test/run_tests.sh:16-19,
+  SURVEY.md §4). No pyspark required.
+* a real ``pyspark.SparkContext`` — used as-is when available; the framework
+  only calls public RDD methods, so any genuine Spark cluster works.
+"""
+
+
+def is_spark_context(sc):
+    """True if ``sc`` is a real pyspark SparkContext (duck-typed; pyspark may
+    not be installed at all)."""
+    mod = type(sc).__module__ or ""
+    return mod.startswith("pyspark")

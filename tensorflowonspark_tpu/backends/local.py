@@ -1,0 +1,298 @@
+"""Local multi-process execution backend — the Spark stand-in.
+
+Emulates exactly the Spark semantics the framework depends on (SURVEY.md §4:
+the reference's hard invariant is *one task slot per executor*, which its test
+harness realized as a 2-worker local Standalone cluster with 1 core each):
+
+* N long-lived **executor processes**, each with its own working directory and
+  a single task slot — so per-executor state (the IPC channel, the jax child
+  process, the executor-state file) survives across tasks, like
+  ``SPARK_REUSE_WORKER=1``.
+* **Jobs** fan partition tasks out to executors. Launch jobs can *pin*
+  partition *i* to executor *i* (Spark achieves the same distribution
+  stochastically plus the reference's retry-on-stale-manager trick,
+  TFSparkNode.py:173-179); feed jobs go through a shared queue and land on
+  whichever executor is free — exercising the reconnect-via-state-file path.
+* Lazy RDDs with ``mapPartitions`` composition; actions are
+  ``collect``/``foreachPartition``/``count``/``sum``.
+
+This backend is a first-class deployment option for single-host TPU boxes (no
+JVM needed) *and* the test harness for the Spark code paths.
+"""
+
+import logging
+import os
+import queue
+import shutil
+import tempfile
+import threading
+import traceback
+import uuid
+
+import cloudpickle
+
+logger = logging.getLogger(__name__)
+
+_mp = __import__("multiprocessing").get_context("fork")
+
+#: module-global registry, inside each executor process, of background
+#: child processes started by node-launch tasks (reaped at executor stop)
+_executor_children = []
+
+
+def register_child_process(proc):
+    """Called from node-launch tasks to let the executor reap the jax child."""
+    _executor_children.append(proc)
+
+
+def _executor_main(executor_id, workdir, private_q, shared_q, result_q, stop_ev):
+    os.chdir(workdir)
+    os.environ["TOS_LOCAL_EXECUTOR_ID"] = str(executor_id)
+    logger.info("local executor %d up in %s", executor_id, workdir)
+    while not stop_ev.is_set():
+        task = None
+        try:
+            task = private_q.get(timeout=0.05)
+        except queue.Empty:
+            try:
+                task = shared_q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+        if task is None:
+            break
+        job_id, pidx, blob = task
+        try:
+            fn, data = cloudpickle.loads(blob)
+            result = fn(iter(data))
+            payload = cloudpickle.dumps(list(result) if result is not None else None)
+            result_q.put((job_id, pidx, executor_id, "ok", payload))
+        except BaseException:
+            result_q.put((job_id, pidx, executor_id, "error", traceback.format_exc()))
+    # reap background children (the jax processes) on the way out
+    for proc in _executor_children:
+        if proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=5)
+    logger.info("local executor %d down", executor_id)
+
+
+class TaskError(RuntimeError):
+    """A partition task failed on an executor; carries the remote traceback."""
+
+    def __init__(self, executor_id, partition, remote_traceback):
+        super().__init__(
+            "task for partition {} failed on executor {}:\n{}".format(
+                partition, executor_id, remote_traceback
+            )
+        )
+        self.executor_id = executor_id
+        self.partition = partition
+        self.remote_traceback = remote_traceback
+
+
+class _Job:
+    def __init__(self, job_id, num_tasks):
+        self.job_id = job_id
+        self.num_tasks = num_tasks
+        self.results = {}
+        self.error = None
+        self.done = threading.Event()
+
+    def wait(self, timeout=None):
+        if not self.done.wait(timeout=timeout):
+            raise TimeoutError("job {} did not finish in {}s".format(self.job_id, timeout))
+        if self.error is not None:
+            raise self.error
+        return [self.results[i] for i in range(self.num_tasks)]
+
+
+class LocalRDD:
+    """Minimal lazy RDD: a list of partitions + a chain of per-partition
+    iterator transforms."""
+
+    def __init__(self, sc, partitions, fns=()):
+        self._sc = sc
+        self._partitions = partitions
+        self._fns = tuple(fns)
+        self._pinned = False
+
+    # transformations ---------------------------------------------------------
+
+    def mapPartitions(self, fn):
+        rdd = LocalRDD(self._sc, self._partitions, self._fns + (fn,))
+        rdd._pinned = self._pinned
+        return rdd
+
+    def map(self, fn):
+        def _mapper(it, _fn=fn):
+            return (_fn(x) for x in it)
+
+        return self.mapPartitions(_mapper)
+
+    def union(self, other):
+        if self._fns or other._fns:
+            raise NotImplementedError("union of transformed local RDDs")
+        return LocalRDD(self._sc, self._partitions + other._partitions)
+
+    def cache(self):
+        return self
+
+    # actions -----------------------------------------------------------------
+
+    def getNumPartitions(self):
+        return len(self._partitions)
+
+    def foreachPartition(self, fn):
+        self.mapPartitions(fn)._execute()
+        return None
+
+    def collect(self):
+        parts = self._execute()
+        return [x for part in parts for x in (part or [])]
+
+    def count(self):
+        return len(self.collect())
+
+    def sum(self):
+        return sum(self.collect())
+
+    def _execute(self):
+        fns = self._fns
+
+        def _chain(it, _fns=fns):
+            for f in _fns:
+                it = f(it)
+            return it if it is not None else []
+
+        job = self._sc._submit_job(self._partitions, _chain, pin=self._pinned)
+        return job.wait(timeout=self._sc.task_timeout)
+
+
+class LocalSparkContext:
+    """Driver handle to the local executor pool (the ``sc`` stand-in)."""
+
+    PIN_SUPPORTED = True
+
+    def __init__(self, num_executors=2, workdir_root=None, task_timeout=600):
+        self.num_executors = num_executors
+        self.defaultParallelism = num_executors
+        self.task_timeout = task_timeout
+        self.applicationId = "local-" + uuid.uuid4().hex[:8]
+        self.defaultFS = "file://"
+        self._workdir_root = workdir_root or tempfile.mkdtemp(prefix="tos_local_")
+        self._own_workdir = workdir_root is None
+        self._result_q = _mp.Queue()
+        self._shared_q = _mp.Queue()
+        self._stop_ev = _mp.Event()
+        self._jobs = {}
+        self._jobs_lock = threading.Lock()
+        self._job_counter = 0
+        self._private_qs = []
+        self._procs = []
+        for i in range(num_executors):
+            wd = os.path.join(self._workdir_root, "executor-{}".format(i))
+            os.makedirs(wd, exist_ok=True)
+            pq = _mp.Queue()
+            proc = _mp.Process(
+                target=_executor_main,
+                args=(i, wd, pq, self._shared_q, self._result_q, self._stop_ev),
+                name="local-executor-{}".format(i),
+                daemon=False,
+            )
+            proc.start()
+            self._private_qs.append(pq)
+            self._procs.append(proc)
+        self._collector = threading.Thread(
+            target=self._collect_results, name="tos-local-collector", daemon=True
+        )
+        self._collector.start()
+
+    # Spark-surface API -------------------------------------------------------
+
+    def parallelize(self, data, numSlices=None, pin_to_executors=False):
+        """``pin_to_executors`` may be True (partition i → executor i) or an
+        explicit list of executor ids (partition i → executor ids[i])."""
+        data = list(data)
+        n = numSlices or self.defaultParallelism
+        n = max(1, min(n, len(data)) if data else n)
+        size, extra = divmod(len(data), n)
+        partitions, start = [], 0
+        for i in range(n):
+            end = start + size + (1 if i < extra else 0)
+            partitions.append(data[start:end])
+            start = end
+        rdd = LocalRDD(self, partitions)
+        rdd._pinned = (
+            list(pin_to_executors) if isinstance(pin_to_executors, (list, tuple)) else bool(pin_to_executors)
+        )
+        return rdd
+
+    def union(self, rdds):
+        out = rdds[0]
+        for r in rdds[1:]:
+            out = out.union(r)
+        return out
+
+    def stop(self, cleanup=True):
+        self._stop_ev.set()
+        for pq in self._private_qs:
+            try:
+                pq.put(None)
+            except (OSError, ValueError):
+                pass
+        for proc in self._procs:
+            proc.join(timeout=10)
+            if proc.is_alive():
+                logger.warning("killing unresponsive executor %s", proc.name)
+                proc.kill()
+                proc.join(timeout=5)
+        if cleanup and self._own_workdir:
+            shutil.rmtree(self._workdir_root, ignore_errors=True)
+
+    # scheduling --------------------------------------------------------------
+
+    def _submit_job(self, partitions, fn, pin=False):
+        with self._jobs_lock:
+            self._job_counter += 1
+            job_id = self._job_counter
+            job = _Job(job_id, len(partitions))
+            self._jobs[job_id] = job
+        targets = None
+        if pin:
+            targets = list(pin) if isinstance(pin, (list, tuple)) else list(range(len(partitions)))
+            if len(targets) < len(partitions) or any(t >= self.num_executors for t in targets):
+                raise ValueError(
+                    "cannot pin {} partitions onto executors {} (pool size {})".format(
+                        len(partitions), targets, self.num_executors
+                    )
+                )
+        for pidx, part in enumerate(partitions):
+            blob = cloudpickle.dumps((fn, part))
+            if targets is not None:
+                self._private_qs[targets[pidx]].put((job_id, pidx, blob))
+            else:
+                self._shared_q.put((job_id, pidx, blob))
+        return job
+
+    def _collect_results(self):
+        while True:
+            try:
+                job_id, pidx, eid, status, payload = self._result_q.get(timeout=0.2)
+            except queue.Empty:
+                if self._stop_ev.is_set():
+                    return
+                continue
+            with self._jobs_lock:
+                job = self._jobs.get(job_id)
+            if job is None:
+                continue
+            if status == "error":
+                job.error = TaskError(eid, pidx, payload)
+                job.done.set()
+            else:
+                job.results[pidx] = cloudpickle.loads(payload)
+                if len(job.results) == job.num_tasks:
+                    job.done.set()
+            if job.done.is_set():
+                with self._jobs_lock:
+                    self._jobs.pop(job_id, None)

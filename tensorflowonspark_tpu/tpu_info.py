@@ -1,0 +1,123 @@
+"""TPU topology discovery and chip-visibility control.
+
+The TPU-native replacement for the reference's ``gpu_info.py`` (nvidia-smi
+scraping + ``CUDA_VISIBLE_DEVICES`` pinning,
+/root/reference/tensorflowonspark/gpu_info.py:54-116). TPUs need a different
+model: a host owns all of its chips through libtpu (one process per host by
+default), topology comes from the TPU runtime env / device files rather than a
+CLI tool, and visibility is controlled with ``TPU_VISIBLE_CHIPS`` /
+``TPU_PROCESS_BOUNDS`` instead of a device list.
+
+Nothing here imports jax — these probes run in the lightweight executor
+process before the jax child is forked.
+"""
+
+import glob
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+#: env vars consulted for explicit topology overrides
+ENV_CHIP_COUNT = "TOS_TPU_CHIPS_PER_HOST"
+ENV_ACCEL_TYPE = "TOS_TPU_ACCELERATOR_TYPE"
+
+#: accelerator type → (chips per host, total chips) for common Cloud TPU slices
+_KNOWN_TOPOLOGIES = {
+    "v4-8": (4, 4),
+    "v4-16": (4, 8),
+    "v4-32": (4, 16),
+    "v5e-1": (1, 1),
+    "v5e-4": (4, 4),
+    "v5e-8": (8, 8),
+    "v5e-16": (4, 16),
+    "v5e-32": (4, 32),
+    "v5e-64": (4, 64),
+    "v5e-128": (4, 128),
+    "v5e-256": (4, 256),
+    "v5p-8": (4, 4),
+    "v5p-16": (4, 8),
+    "v6e-8": (8, 8),
+    "v6e-16": (4, 16),
+    "v6e-32": (4, 32),
+}
+
+
+def detect_local_chips():
+    """Best-effort count of TPU chips attached to this host.
+
+    Order: explicit override env → TPU runtime env hints → accel device files
+    (``/dev/accel*`` for PCIe-attached TPU, ``/dev/vfio``) → 0 (no TPU).
+    """
+    override = os.environ.get(ENV_CHIP_COUNT)
+    if override:
+        return int(override)
+    # Cloud TPU VM runtime exports these
+    for var in ("TPU_CHIPS_PER_HOST_BOUNDS", "TPU_CHIPS_PER_PROCESS_BOUNDS"):
+        bounds = os.environ.get(var)
+        if bounds:
+            try:
+                dims = [int(x) for x in bounds.split(",")]
+                count = 1
+                for d in dims:
+                    count *= d
+                return count
+            except ValueError:
+                pass
+    accels = glob.glob("/dev/accel*")
+    if accels:
+        return len(accels)
+    if os.path.isdir("/dev/vfio"):
+        vfio = [p for p in glob.glob("/dev/vfio/*") if os.path.basename(p).isdigit()]
+        if vfio:
+            return len(vfio)
+    return 0
+
+
+def is_tpu_available():
+    """Analogue of gpu_info.is_gpu_available (reference gpu_info.py:45)."""
+    return detect_local_chips() > 0
+
+
+def accelerator_type():
+    """Accelerator type string (e.g. 'v5e-32') if known, else None."""
+    return os.environ.get(ENV_ACCEL_TYPE) or os.environ.get("TPU_ACCELERATOR_TYPE")
+
+
+def topology_for(accel_type):
+    """(chips_per_host, total_chips) for a known accelerator type, else None."""
+    return _KNOWN_TOPOLOGIES.get(accel_type)
+
+
+def local_topology():
+    """Summary dict of this host's TPU situation, shipped in the reservation
+    record so the coordinator sees the whole slice's shape (SURVEY.md §2.8:
+    the reservation server's role grows to include TPU topology exchange)."""
+    accel = accelerator_type()
+    chips = detect_local_chips()
+    if chips == 0 and accel and accel in _KNOWN_TOPOLOGIES:
+        chips = _KNOWN_TOPOLOGIES[accel][0]
+    return {
+        "accelerator_type": accel,
+        "num_chips": chips,
+        "worker_id": os.environ.get("TPU_WORKER_ID"),
+        "worker_hostnames": os.environ.get("TPU_WORKER_HOSTNAMES"),
+    }
+
+
+def visibility_env(chip_ids=None, platform=None):
+    """Environment to pin a child process to a subset of chips / a platform.
+
+    The CUDA_VISIBLE_DEVICES analogue (reference gpu_info.py:102-113 placed
+    workers on GPUs by local index). On TPU the common case is *all* chips to
+    *one* process per host; chip subsetting is for megacore-style splits or
+    colocated independent replicas (TFParallel).
+    """
+    env = {}
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    if chip_ids is not None:
+        env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in chip_ids)
+        env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"
+        env["TPU_PROCESS_BOUNDS"] = "1,1,1"
+    return env
