@@ -57,8 +57,14 @@ class TFCluster:
         logger.info("feeding training data (epochs=%s)", num_epochs)
         assert self.input_mode == InputMode.SPARK, "train() requires InputMode.SPARK"
         assert dataRDD is not None, "dataRDD is required"
+        assert num_epochs >= 0, "num_epochs cannot be negative"
+        if num_epochs == 0:
+            # unspecified: feed "many" epochs and rely on the training loop to
+            # terminate the feed at its target step count (reference
+            # TFCluster.py:88-92 picks the same arbitrary 10)
+            num_epochs = 10
         rdd = dataRDD
-        if num_epochs and num_epochs > 1:
+        if num_epochs > 1:
             rdd = self.sc.union([dataRDD] * num_epochs)
         rdd.foreachPartition(
             TFSparkNode.train(self.cluster_info, self.cluster_meta, feed_timeout=feed_timeout, qname=qname)
@@ -86,26 +92,28 @@ class TFCluster:
         logger.info("shutting down cluster")
         del ssc  # streaming handled at a higher layer
 
-        if self.input_mode == InputMode.SPARK:
-            self._shutdown_workers(grace_secs)
-
-        # driver-managed roles: post None on their remote control queues
-        # (reference TFCluster.py:188-194)
-        for row in self.cluster_info:
-            if row.get("manager_addr"):
-                try:
-                    mgr = TFManager.connect(tuple(row["manager_addr"]), self.cluster_meta["authkey"])
-                    mgr.get_queue("control").put(None, block=True)
-                except Exception as e:
-                    logger.warning(
-                        "could not stop %s:%s at %s: %s",
-                        row["job_name"], row["task_index"], row["manager_addr"], e,
-                    )
-
-        self.launch_thread.join(timeout=timeout)
+        try:
+            if self.input_mode == InputMode.SPARK:
+                self._shutdown_workers(grace_secs)
+        finally:
+            # even when a worker surfaced an error, stop driver-managed roles,
+            # reap the launch job, and release the reservation server — a
+            # long-lived driver must be able to retry cluster.run without
+            # leaking server threads/sockets
+            for row in self.cluster_info:
+                if row.get("manager_addr"):
+                    try:
+                        mgr = TFManager.connect(tuple(row["manager_addr"]), self.cluster_meta["authkey"])
+                        mgr.get_queue("control").put(None, block=True)
+                    except Exception as e:
+                        logger.warning(
+                            "could not stop %s:%s at %s: %s",
+                            row["job_name"], row["task_index"], row["manager_addr"], e,
+                        )
+            self.launch_thread.join(timeout=timeout)
+            self.server.stop()
         if self.launch_thread.is_alive():
             raise RuntimeError("cluster did not shut down within {}s".format(timeout))
-        self.server.stop()
         if self.tf_status.get("error"):
             raise RuntimeError("cluster failed: {}".format(self.tf_status["error"]))
         logger.info("cluster shut down cleanly")
@@ -137,7 +145,7 @@ class TFCluster:
                     "could not reach %s:%s for shutdown: %s", row["job_name"], row["task_index"], e
                 )
         errors = []
-        deadline = time.time() + max(grace_secs, 60) + grace_secs
+        deadline = time.time() + max(grace_secs, 60)
         for row, mgr in channels:
             while True:
                 status = mgr.get("child_status")
@@ -275,16 +283,20 @@ def run(
     launch_thread = threading.Thread(target=_start, name="tos-cluster-launch", daemon=True)
     launch_thread.start()
 
-    cluster_info = server.await_reservations(tf_status, timeout=reservation_timeout)
+    try:
+        cluster_info = server.await_reservations(tf_status, timeout=reservation_timeout)
 
-    # duplicate-node sanity check (reference TFCluster.py:352-367)
-    eids = [r["executor_id"] for r in cluster_info]
-    if sorted(eids) != sorted(template.keys()):
-        raise RuntimeError(
-            "cluster assembled with wrong executor set: got {} expected {}".format(
-                sorted(eids), sorted(template.keys())
+        # duplicate-node sanity check (reference TFCluster.py:352-367)
+        eids = [r["executor_id"] for r in cluster_info]
+        if sorted(eids) != sorted(template.keys()):
+            raise RuntimeError(
+                "cluster assembled with wrong executor set: got {} expected {}".format(
+                    sorted(eids), sorted(template.keys())
+                )
             )
-        )
+    except BaseException:
+        server.stop()  # don't leak the listener thread/socket on failed assembly
+        raise
     for row in sorted(cluster_info, key=lambda r: r["executor_id"]):
         logger.info(
             "node: executor=%d %s:%d @ %s:%s chips=%s",

@@ -236,9 +236,11 @@ class _NodeLaunchTask:
         # unlike scattering shutdown tasks and hoping the scheduler spreads
         # them one-per-executor (the reference's approach, TFCluster.py:174).
         mgr = TFManager.start(authkey=authkey, queues=self.queues, mode="remote")
-        old = _live_channels.pop(executor_id, None)
-        if old is not None:
-            old.shutdown()  # previous cluster's channel on a reused executor
+        # at most one live node per executor process (enforced above), so any
+        # existing channel — whatever cluster/node id it served — is from a
+        # finished run on this reused executor: shut it down, don't leak it
+        for key in list(_live_channels):
+            _live_channels.pop(key).shutdown()
         _live_channels[executor_id] = mgr  # pin the channel beyond this task
         mgr.set("state", "starting")
 

@@ -60,9 +60,10 @@ def _executor_main(executor_id, workdir, private_q, shared_q, result_q, stop_ev)
                 continue
         if task is None:
             break
-        job_id, pidx, blob = task
+        job_id, pidx, fn_blob, data_blob = task
         try:
-            fn, data = cloudpickle.loads(blob)
+            fn = cloudpickle.loads(fn_blob)
+            data = cloudpickle.loads(data_blob)
             result = fn(iter(data))
             payload = cloudpickle.dumps(list(result) if result is not None else None)
             result_q.put((job_id, pidx, executor_id, "ok", payload))
@@ -107,19 +108,19 @@ class _Job:
 
 
 class LocalRDD:
-    """Minimal lazy RDD: a list of partitions + a chain of per-partition
-    iterator transforms."""
+    """Minimal lazy RDD: each partition carries its data and its own chain of
+    per-partition iterator transforms (so unions of differently-transformed
+    RDDs — e.g. the epochs-via-union trick over a mapped RDD — just work)."""
 
-    def __init__(self, sc, partitions, fns=()):
+    def __init__(self, sc, parts):
         self._sc = sc
-        self._partitions = partitions
-        self._fns = tuple(fns)
+        self._parts = list(parts)  # [(data, fns_tuple), ...]
         self._pinned = False
 
     # transformations ---------------------------------------------------------
 
     def mapPartitions(self, fn):
-        rdd = LocalRDD(self._sc, self._partitions, self._fns + (fn,))
+        rdd = LocalRDD(self._sc, [(data, fns + (fn,)) for data, fns in self._parts])
         rdd._pinned = self._pinned
         return rdd
 
@@ -130,9 +131,7 @@ class LocalRDD:
         return self.mapPartitions(_mapper)
 
     def union(self, other):
-        if self._fns or other._fns:
-            raise NotImplementedError("union of transformed local RDDs")
-        return LocalRDD(self._sc, self._partitions + other._partitions)
+        return LocalRDD(self._sc, self._parts + other._parts)
 
     def cache(self):
         return self
@@ -140,7 +139,7 @@ class LocalRDD:
     # actions -----------------------------------------------------------------
 
     def getNumPartitions(self):
-        return len(self._partitions)
+        return len(self._parts)
 
     def foreachPartition(self, fn):
         self.mapPartitions(fn)._execute()
@@ -157,15 +156,17 @@ class LocalRDD:
         return sum(self.collect())
 
     def _execute(self):
-        fns = self._fns
-
-        def _chain(it, _fns=fns):
-            for f in _fns:
-                it = f(it)
-            return it if it is not None else []
-
-        job = self._sc._submit_job(self._partitions, _chain, pin=self._pinned)
+        job = self._sc._submit_job(self._parts, pin=self._pinned)
         return job.wait(timeout=self._sc.task_timeout)
+
+
+def _make_chain(fns):
+    def _chain(it, _fns=fns):
+        for f in _fns:
+            it = f(it)
+        return it if it is not None else []
+
+    return _chain
 
 
 class LocalSparkContext:
@@ -221,7 +222,7 @@ class LocalSparkContext:
             end = start + size + (1 if i < extra else 0)
             partitions.append(data[start:end])
             start = end
-        rdd = LocalRDD(self, partitions)
+        rdd = LocalRDD(self, [(p, ()) for p in partitions])
         rdd._pinned = (
             list(pin_to_executors) if isinstance(pin_to_executors, (list, tuple)) else bool(pin_to_executors)
         )
@@ -251,27 +252,35 @@ class LocalSparkContext:
 
     # scheduling --------------------------------------------------------------
 
-    def _submit_job(self, partitions, fn, pin=False):
+    def _submit_job(self, parts, pin=False):
+        """``parts``: [(data, fns_tuple), ...]. Each distinct transform chain
+        is cloudpickled once per job (a feed job unions the same chain over
+        epochs × partitions; re-serializing the closure per partition was the
+        dominant driver-side cost)."""
         with self._jobs_lock:
             self._job_counter += 1
             job_id = self._job_counter
-            job = _Job(job_id, len(partitions))
+            job = _Job(job_id, len(parts))
             self._jobs[job_id] = job
         targets = None
         if pin:
-            targets = list(pin) if isinstance(pin, (list, tuple)) else list(range(len(partitions)))
-            if len(targets) < len(partitions) or any(t >= self.num_executors for t in targets):
+            targets = list(pin) if isinstance(pin, (list, tuple)) else list(range(len(parts)))
+            if len(targets) < len(parts) or any(t >= self.num_executors for t in targets):
                 raise ValueError(
                     "cannot pin {} partitions onto executors {} (pool size {})".format(
-                        len(partitions), targets, self.num_executors
+                        len(parts), targets, self.num_executors
                     )
                 )
-        for pidx, part in enumerate(partitions):
-            blob = cloudpickle.dumps((fn, part))
+        fn_blobs = {}
+        for pidx, (data, fns) in enumerate(parts):
+            fn_blob = fn_blobs.get(fns)
+            if fn_blob is None:
+                fn_blob = fn_blobs[fns] = cloudpickle.dumps(_make_chain(fns))
+            task = (job_id, pidx, fn_blob, cloudpickle.dumps(data))
             if targets is not None:
-                self._private_qs[targets[pidx]].put((job_id, pidx, blob))
+                self._private_qs[targets[pidx]].put(task)
             else:
-                self._shared_q.put((job_id, pidx, blob))
+                self._shared_q.put(task)
         return job
 
     def _collect_results(self):

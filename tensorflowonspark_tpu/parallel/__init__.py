@@ -1,0 +1,42 @@
+"""Parallelism core: device meshes, shardings, collectives, ring attention.
+
+This package is the TPU-native replacement for the reference's entire
+"training plane" (SURVEY.md §2.8): where TensorFlowOnSpark delegated
+distribution to TF's gRPC ClusterSpec + NCCL/RING collective all-reduce
+(/root/reference/tensorflowonspark/TFNode.py:123-129, TFSparkNode.py:277-299),
+here distribution is expressed as shardings over a named
+:class:`jax.sharding.Mesh` and XLA inserts the collectives (all-reduce /
+all-gather / reduce-scatter / ppermute) over ICI within a slice and DCN across
+slices.
+
+Canonical mesh axes (any subset may be present, always in this order):
+
+=======  =====================================================================
+``dp``   pure data parallelism (params replicated, batch sharded)
+``fsdp`` data parallelism with fully-sharded params (batch AND params sharded)
+``tp``   tensor (a.k.a. model) parallelism — activations/weights sharded
+``sp``   sequence/context parallelism — ring attention over this axis
+``ep``   expert parallelism for MoE layers
+=======  =====================================================================
+"""
+
+from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_ORDER,
+    build_mesh,
+    local_mesh,
+    mesh_shape,
+)
+from tensorflowonspark_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    batch_spec,
+    data_axes,
+    fsdp_param_specs,
+    replicated,
+    shard_batch,
+    shard_params,
+)
+from tensorflowonspark_tpu.parallel import collectives  # noqa: F401
+from tensorflowonspark_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_attention_sharded,
+)

@@ -1,0 +1,109 @@
+"""Device-mesh construction over ICI/DCN.
+
+The mesh is the foundation of every parallelism strategy (SURVEY.md §2.7): the
+reference's sync data parallelism (``MultiWorkerMirroredStrategy`` + NCCL ring)
+becomes a 1-D ``dp`` mesh; its async PS path has no TPU analogue and is served
+by the same sync mesh; TP/PP/SP/EP — absent from the reference — are additional
+axes on the same mesh, so adding them is a sharding change, not a rewrite
+(SURVEY.md §7 hard part 6).
+"""
+
+import logging
+import math
+
+logger = logging.getLogger(__name__)
+
+#: canonical axis order; meshes are always built with axes in this order so
+#: collectives ride ICI for the innermost (fastest-varying) axes.
+AXIS_ORDER = ("dp", "fsdp", "tp", "sp", "ep")
+
+
+def _normalize_axes(axes, num_devices):
+    """Resolve an axes spec into an ordered {name: size} with product == num_devices.
+
+    ``axes`` may be None (pure dp), a dict (one size may be -1 = "fill"), or a
+    sequence of (name, size) pairs. Unknown axis names are allowed (appended
+    after the canonical ones, in given order) so user code can define custom
+    axes (e.g. a "stage" axis for pipeline parallelism).
+    """
+    if axes is None:
+        axes = {"dp": -1}
+    if not isinstance(axes, dict):
+        axes = dict(axes)
+    known = [a for a in AXIS_ORDER if a in axes]
+    extra = [a for a in axes if a not in AXIS_ORDER]
+    ordered = known + extra
+
+    fills = [a for a in ordered if axes[a] == -1]
+    if len(fills) > 1:
+        raise ValueError("at most one axis may have size -1 (got {})".format(fills))
+    fixed = math.prod(axes[a] for a in ordered if axes[a] != -1)
+    if fills:
+        if num_devices % fixed != 0:
+            raise ValueError(
+                "cannot fill axis {!r}: {} devices not divisible by {}".format(
+                    fills[0], num_devices, fixed
+                )
+            )
+        axes = dict(axes)
+        axes[fills[0]] = num_devices // fixed
+        fixed = num_devices
+    if fixed != num_devices:
+        raise ValueError(
+            "mesh axes {} use {} devices but {} are available".format(
+                {a: axes[a] for a in ordered}, fixed, num_devices
+            )
+        )
+    return {a: axes[a] for a in ordered}
+
+
+def build_mesh(axes=None, devices=None, drop_trivial=False):
+    """Build a :class:`jax.sharding.Mesh` with named axes over the devices.
+
+    On real TPU hardware the physical layout comes from
+    ``mesh_utils.create_device_mesh`` so that neighbouring mesh coordinates are
+    ICI neighbours and XLA collectives ride the torus; on CPU/virtual devices a
+    plain reshape is used.
+
+    ``axes``: dict of axis name → size; one size may be -1 ("use remaining
+    devices"); default ``{"dp": -1}``. ``drop_trivial`` removes size-1 axes.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    shape = _normalize_axes(axes, len(devices))
+    if drop_trivial:
+        shape = {a: s for a, s in shape.items() if s > 1} or {"dp": 1}
+
+    dims = tuple(shape.values())
+    platform = devices[0].platform if devices else "cpu"
+    if platform == "tpu":
+        try:
+            from jax.experimental import mesh_utils
+
+            mesh_devices = mesh_utils.create_device_mesh(dims, devices=devices)
+        except Exception as e:  # pragma: no cover - depends on physical topology
+            logger.warning("create_device_mesh failed (%s); using device order", e)
+            import numpy as np
+
+            mesh_devices = np.asarray(devices).reshape(dims)
+    else:
+        import numpy as np
+
+        mesh_devices = np.asarray(devices).reshape(dims)
+    logger.info("mesh: %s over %d %s device(s)", shape, len(devices), platform)
+    return Mesh(mesh_devices, tuple(shape.keys()))
+
+
+def local_mesh(axes=None):
+    """Mesh over this process's addressable devices only (single-host)."""
+    import jax
+
+    return build_mesh(axes, devices=jax.local_devices())
+
+
+def mesh_shape(mesh):
+    """{axis: size} for a mesh."""
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -1,0 +1,123 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Long-context capability absent from the reference (SURVEY.md §5 "Long-context /
+sequence parallelism: Absent") but first-class here: sequence length is sharded
+over the ``sp`` mesh axis; each device holds one block of queries and one block
+of keys/values, computes blockwise attention with a numerically-stable online
+softmax (flash-attention style accumulation), and rotates the K/V blocks around
+the ring with ``lax.ppermute`` so every query block eventually sees every K/V
+block. Communication is neighbour-to-neighbour, so on TPU it rides single-hop
+ICI links and overlaps with the matmuls of the previous block.
+
+Memory per device is O(L_local²) per block pair instead of O(L²) for the full
+sequence, so max context length scales linearly with the number of devices on
+the ``sp`` axis.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: finite stand-in for -inf so fully-masked blocks produce exp(-BIG)=0 instead
+#: of NaN via (-inf) - (-inf) in the running-max correction.
+_NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _block_attn(q_scaled, k, v, o, m, l, q_pos, k_pos, causal):
+    """One flash-style accumulation step: fold a K/V block into (o, m, l)."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q_scaled, k.astype(jnp.float32))
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, _NEG_BIG)
+    m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new)
+    l_new = l * corr + p.sum(axis=-1, keepdims=True)
+    o_new = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Blockwise ring attention; call inside ``shard_map`` over ``axis_name``.
+
+    ``q``/``k``/``v``: the *local* sequence block, ``[batch, heads, seq_local,
+    head_dim]``. Per ring step, attention against the currently-held K/V block
+    is accumulated online, then K/V rotate one hop (member i → i+1). Global
+    causal masking uses each block's origin index, so the result is exactly
+    standard causal attention on the concatenated sequence.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    _, _, l_q, head_dim = q.shape
+    l_k = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(head_dim)
+    q_scaled = q.astype(jnp.float32) * scale
+    q_pos = my * l_q + jnp.arange(l_q)
+
+    # accumulators derive from q/v zeros so they inherit the inputs' full set
+    # of varying mesh axes — keeps the scan carry type stable under
+    # shard_map's varying-axes checks regardless of what else (dp/fsdp) the
+    # inputs are sharded over
+    zero_qv = q_scaled[..., :1] * 0 + v.astype(jnp.float32)[..., :1].sum(2, keepdims=True) * 0
+    o0 = jnp.zeros(q.shape[:3] + (v.shape[3],), jnp.float32) + zero_qv
+    m0 = jnp.full(q.shape[:3] + (1,), _NEG_BIG, jnp.float32) + zero_qv
+    l0 = jnp.zeros(q.shape[:3] + (1,), jnp.float32) + zero_qv
+
+    def step(carry, s):
+        o, m, l, k_cur, v_cur = carry
+        src = (my - s) % n  # whose block we hold after s rotations
+        k_pos = src * l_k + jnp.arange(l_k)
+        o, m, l = _block_attn(q_scaled, k_cur, v_cur, o, m, l, q_pos, k_pos, causal)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_cur = lax.ppermute(k_cur, axis_name, perm=perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm=perm)
+        return (o, m, l, k_cur, v_cur), None
+
+    (o, _, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, causal=False, scale=None, axis="sp"):
+    """Apply ring attention to globally-shaped ``[B, H, L, D]`` arrays, with
+    the sequence dim sharded over ``axis`` and batch over the data axes.
+
+    Falls back to plain (single-block) attention when the mesh has no ``axis``
+    axis — same math, no ring.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel.sharding import data_axes
+
+    if axis not in mesh.axis_names or dict(
+        zip(mesh.axis_names, mesh.devices.shape)
+    )[axis] == 1:
+        return plain_attention(q, k, v, causal=causal, scale=scale)
+
+    batch = data_axes(mesh)
+    bspec = batch if len(batch) > 1 else (batch[0] if batch else None)
+    spec = P(bspec, None, axis, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis, causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def plain_attention(q, k, v, causal=False, scale=None):
+    """Reference single-device attention (the L_local == L ring case)."""
+    head_dim = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(head_dim)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    if causal:
+        l_q, l_k = q.shape[2], k.shape[2]
+        mask = jnp.arange(l_q)[:, None] >= jnp.arange(l_k)[None, :]
+        scores = jnp.where(mask[None, None], scores, _NEG_BIG)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

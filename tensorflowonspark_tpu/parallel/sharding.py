@@ -1,0 +1,113 @@
+"""Sharding rules: how batches and parameters map onto the mesh.
+
+The replacement for the reference's implicit placement model (every worker
+holds a full replica, NCCL all-reduces gradients): here placement is explicit
+`jax.sharding.NamedSharding`s, and XLA derives the collectives. Batch tensors
+shard their leading dimension across the data axes (``dp`` × ``fsdp``);
+parameters are replicated for pure DP or sharded along ``fsdp`` (ZeRO-3 style)
+with per-array axis selection.
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def data_axes(mesh):
+    """The mesh axes a batch's leading dim is sharded over."""
+    return tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+
+
+def batch_spec(mesh):
+    """PartitionSpec for a batch: leading dim over the data axes."""
+    from jax.sharding import PartitionSpec as P
+
+    axes = data_axes(mesh)
+    if not axes:
+        return P()
+    return P(axes if len(axes) > 1 else axes[0])
+
+
+def batch_sharding(mesh):
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, batch_spec(mesh))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def _pick_fsdp_axis(shape, axis_size, min_weight_size):
+    """Index of the dim to shard along fsdp: the largest dim divisible by the
+    axis size, on arrays big enough to be worth sharding; None = replicate."""
+    import math
+
+    if math.prod(shape) < min_weight_size:
+        return None
+    best, best_dim = None, -1
+    for i, d in enumerate(shape):
+        if d % axis_size == 0 and d > best_dim:
+            best, best_dim = i, d
+    return best
+
+
+def fsdp_param_specs(params, mesh, min_weight_size=2**14):
+    """PartitionSpec pytree for params: fully-shard eligible arrays along the
+    ``fsdp`` axis (ZeRO-3), replicate the rest (biases, norm scales, small
+    embeddings). With no ``fsdp`` axis in the mesh, everything replicates."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if "fsdp" not in mesh.axis_names:
+        return jax.tree.map(lambda _: P(), params)
+    axis_size = mesh_axis_size(mesh, "fsdp")
+
+    def spec_for(x):
+        shape = getattr(x, "shape", ())
+        dim = _pick_fsdp_axis(shape, axis_size, min_weight_size)
+        if dim is None:
+            return P()
+        spec = [None] * len(shape)
+        spec[dim] = "fsdp"
+        return P(*spec)
+
+    return jax.tree.map(spec_for, params)
+
+
+def mesh_axis_size(mesh, name):
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get(name, 1)
+
+
+def shard_params(params, mesh, specs=None):
+    """Place a params pytree onto the mesh (replicated or per-array specs)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    if specs is None:
+        specs = fsdp_param_specs(params, mesh)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def shard_batch(batch, mesh):
+    """Place a host-local batch pytree onto the mesh, sharded over data axes.
+
+    Single-process: a plain sharded ``device_put``. Multi-process (one process
+    per TPU host, the TFSparkNode world): each process contributes its local
+    shard via ``make_array_from_process_local_data`` — the device-side analogue
+    of the reference's per-executor feed queues (each executor fed only its own
+    partition; here each host's partition becomes its shard of the global
+    batch).
+    """
+    import jax
+
+    sharding = batch_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x), batch
+    )

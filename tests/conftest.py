@@ -2,15 +2,23 @@
 
 Multi-chip sharding is validated on a virtual 8-device CPU mesh (SURVEY.md §4:
 the reference tested "multi-node" on a 2-worker local standalone cluster; our
-analogue is multi-process local executors + a virtual device mesh). These env
-vars must be set before jax is imported anywhere in the test process.
+analogue is multi-process local executors + a virtual device mesh).
+
+The environment may have already imported jax and pointed it at a real TPU
+(sitecustomize + ``JAX_PLATFORMS``), so plain env vars are not enough: the
+platform is forced back to CPU through the config API, which works as long as
+no backend has been initialized yet, and child processes get the env vars.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # for forked jax child processes
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 # keep XLA's CPU thread usage sane on small CI machines
 os.environ.setdefault("XLA_CPU_MULTI_THREAD_EIGEN", "false")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -43,6 +43,15 @@ def test_union_epochs(sc):
     assert sorted(unioned.collect()) == sorted(list(range(3)) * 3)
 
 
+def test_union_of_transformed_rdds(sc):
+    """The epochs-via-union trick must work on an already-mapped RDD
+    (TFCluster.train unions a user RDD that typically has map chains)."""
+    rdd = sc.parallelize(range(3), 1).map(lambda x: x * 10)
+    other = sc.parallelize(range(2), 1).mapPartitions(_square_partition)
+    unioned = sc.union([rdd, rdd, other])
+    assert sorted(unioned.collect()) == sorted([0, 10, 20] * 2 + [0, 1])
+
+
 def test_error_propagates_with_remote_traceback(sc):
     def boom(it):
         raise ValueError("deliberate failure in task")

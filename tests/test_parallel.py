@@ -1,0 +1,159 @@
+"""parallel/ package tests on the virtual 8-device CPU mesh (conftest.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tensorflowonspark_tpu import parallel
+from tensorflowonspark_tpu.parallel import collectives, mesh as mesh_lib
+from tensorflowonspark_tpu.parallel.ring_attention import (
+    plain_attention,
+    ring_attention_sharded,
+)
+
+
+def test_virtual_device_count():
+    assert jax.device_count() == 8
+
+
+class TestMesh:
+    def test_default_is_pure_dp(self):
+        m = parallel.build_mesh()
+        assert mesh_lib.mesh_shape(m) == {"dp": 8}
+
+    def test_fill_axis(self):
+        m = parallel.build_mesh({"dp": -1, "tp": 2})
+        assert mesh_lib.mesh_shape(m) == {"dp": 4, "tp": 2}
+
+    def test_axis_order_is_canonical(self):
+        m = parallel.build_mesh({"sp": 2, "dp": 2, "tp": 2})
+        assert m.axis_names == ("dp", "tp", "sp")
+
+    def test_custom_axis_appended(self):
+        m = parallel.build_mesh({"dp": 4, "stage": 2})
+        assert m.axis_names == ("dp", "stage")
+
+    def test_bad_product_raises(self):
+        with pytest.raises(ValueError):
+            parallel.build_mesh({"dp": 3})
+
+    def test_two_fills_raise(self):
+        with pytest.raises(ValueError):
+            parallel.build_mesh({"dp": -1, "tp": -1})
+
+
+class TestSharding:
+    def test_batch_spec_dp_only(self):
+        m = parallel.build_mesh({"dp": 8})
+        assert parallel.batch_spec(m) == P("dp")
+
+    def test_batch_spec_dp_fsdp(self):
+        m = parallel.build_mesh({"dp": 2, "fsdp": 4})
+        assert parallel.batch_spec(m) == P(("dp", "fsdp"))
+
+    def test_fsdp_param_specs(self):
+        m = parallel.build_mesh({"fsdp": 8})
+        params = {
+            "dense": {"kernel": jnp.zeros((256, 128)), "bias": jnp.zeros((128,))},
+            "tiny": jnp.zeros((4, 4)),
+        }
+        specs = parallel.fsdp_param_specs(params, m, min_weight_size=1024)
+        assert specs["dense"]["kernel"] == P("fsdp", None)
+        assert specs["dense"]["bias"] == P()  # too small
+        assert specs["tiny"] == P()
+
+    def test_fsdp_spec_picks_divisible_dim(self):
+        m = parallel.build_mesh({"fsdp": 8})
+        # first dim (129) not divisible by 8; second (256) is
+        specs = parallel.fsdp_param_specs({"w": jnp.zeros((129, 256))}, m, min_weight_size=16)
+        assert specs["w"] == P(None, "fsdp")
+
+    def test_shard_batch_and_params_roundtrip(self):
+        m = parallel.build_mesh({"dp": 8})
+        batch = {"x": np.arange(64, dtype=np.float32).reshape(16, 4)}
+        sharded = parallel.shard_batch(batch, m)
+        assert sharded["x"].sharding.spec == P("dp")
+        np.testing.assert_array_equal(np.asarray(sharded["x"]), batch["x"])
+
+        params = parallel.shard_params({"w": jnp.ones((64, 8))}, m)
+        np.testing.assert_array_equal(np.asarray(params["w"]), np.ones((64, 8)))
+
+
+class TestCollectives:
+    def test_psum_pmean_under_shard_map(self):
+        m = parallel.build_mesh({"dp": 8})
+
+        def f(x):
+            return collectives.psum(x, "dp"), collectives.pmean(x, "dp")
+
+        x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+        s, mu = jax.shard_map(f, mesh=m, in_specs=P("dp"), out_specs=P("dp"))(x)
+        np.testing.assert_allclose(np.asarray(s), np.full((8, 1), 28.0))
+        np.testing.assert_allclose(np.asarray(mu), np.full((8, 1), 3.5))
+
+    def test_ring_shift(self):
+        m = parallel.build_mesh({"dp": 8})
+
+        def f(x):
+            return collectives.ring_shift(x, "dp")
+
+        x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+        out = np.asarray(jax.shard_map(f, mesh=m, in_specs=P("dp"), out_specs=P("dp"))(x))
+        np.testing.assert_array_equal(out[:, 0], np.roll(np.arange(8), 1))
+
+    def test_reduce_scatter(self):
+        m = parallel.build_mesh({"dp": 8})
+
+        def f(x):
+            return collectives.reduce_scatter(x, "dp")
+
+        # every member holds the full vector; each ends up with its summed slice
+        x = jnp.arange(8, dtype=jnp.float32)
+        out = np.asarray(jax.shard_map(f, mesh=m, in_specs=P(), out_specs=P("dp"))(x))
+        np.testing.assert_allclose(out, np.arange(8, dtype=np.float32) * 8.0)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_plain_attention(self, causal):
+        m = parallel.build_mesh({"dp": 2, "sp": 4})
+        rng = np.random.default_rng(0)
+        b, h, l, d = 4, 2, 32, 16
+        q, k, v = (
+            jnp.asarray(rng.standard_normal((b, h, l, d)), jnp.float32) for _ in range(3)
+        )
+        expected = plain_attention(q, k, v, causal=causal)
+        got = ring_attention_sharded(q, k, v, m, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+    def test_no_sp_axis_falls_back(self):
+        m = parallel.build_mesh({"dp": 8})
+        rng = np.random.default_rng(1)
+        q, k, v = (
+            jnp.asarray(rng.standard_normal((2, 2, 8, 4)), jnp.float32) for _ in range(3)
+        )
+        got = ring_attention_sharded(q, k, v, m, causal=True)
+        expected = plain_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+    def test_gradients_flow(self):
+        m = parallel.build_mesh({"sp": 8})
+        rng = np.random.default_rng(2)
+        b, h, l, d = 2, 2, 32, 8
+        q, k, v = (
+            jnp.asarray(rng.standard_normal((b, h, l, d)), jnp.float32) for _ in range(3)
+        )
+
+        def loss_ring(q, k, v):
+            return ring_attention_sharded(q, k, v, m, causal=True).sum()
+
+        def loss_plain(q, k, v):
+            return plain_attention(q, k, v, causal=True).sum()
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_plain = jax.grad(loss_plain, argnums=(0, 1, 2))(q, k, v)
+        for gr, gp in zip(g_ring, g_plain):
+            np.testing.assert_allclose(np.asarray(gr), np.asarray(gp), atol=1e-4)
