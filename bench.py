@@ -1,0 +1,102 @@
+"""Benchmark: ResNet-50 synthetic-data training throughput, images/sec/chip.
+
+Matches BASELINE.json's metric ("ResNet-50 ImageNet images/sec/chip"): one
+full training step (fwd + bwd + SGD-momentum update + BatchNorm stats) on
+synthetic 224x224x3 data, bfloat16 compute, timed on this host's chip(s).
+
+The reference repo publishes no numbers (BASELINE.md), so ``vs_baseline``
+is computed against ``REFERENCE_IMG_PER_SEC_PER_CHIP`` — the Cloud-TPU
+reference throughput the north-star target is phrased against ("≥70% of
+Cloud-TPU reference images/sec on a v5e"); vs_baseline ≥ 0.7 meets the bar.
+
+Env knobs: BENCH_TINY=1 (CPU-friendly shapes for smoke runs),
+BENCH_BATCH, BENCH_STEPS.
+
+Prints exactly one JSON line.
+"""
+
+import json
+import os
+import time
+
+
+#: Cloud-TPU reference ResNet-50 training throughput per v5e chip (bf16,
+#: batch 128/chip) that the BASELINE.json target is measured against.
+REFERENCE_IMG_PER_SEC_PER_CHIP = 2000.0
+
+
+def main():
+    tiny = os.environ.get("BENCH_TINY") == "1"
+    if tiny:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+
+    if tiny:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import parallel
+    from tensorflowonspark_tpu.models import resnet
+    from tensorflowonspark_tpu.train import SyncDataParallel
+
+    n_chips = jax.device_count()
+    batch = int(os.environ.get("BENCH_BATCH", 8 if tiny else 128)) * n_chips
+    steps = int(os.environ.get("BENCH_STEPS", 3 if tiny else 20))
+    image_size = 32 if tiny else 224
+    dtype = jnp.float32 if tiny else jnp.bfloat16
+
+    mesh = parallel.build_mesh({"dp": n_chips})
+    strategy = SyncDataParallel(mesh)
+    model = (
+        resnet.resnet56(num_classes=10, dtype=dtype)
+        if tiny
+        else resnet.resnet50(num_classes=1000, dtype=dtype)
+    )
+    optimizer = optax.sgd(0.1, momentum=0.9)
+    state = strategy.create_state(
+        resnet.make_init_fn(model, image_size=image_size), optimizer, jax.random.PRNGKey(0)
+    )
+    step = strategy.compile_train_step(
+        resnet.make_loss_fn(model, weight_decay=1e-4), optimizer, mutable=True
+    )
+
+    rng = np.random.default_rng(0)
+    host_batch = {
+        "image": rng.standard_normal((batch, image_size, image_size, 3)).astype(np.float32),
+        "label": rng.integers(0, 10 if tiny else 1000, batch),
+    }
+    sharded = strategy.shard_batch(host_batch)
+
+    # warmup: compile + 2 steady steps
+    for _ in range(3):
+        state, metrics = step(state, sharded)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, sharded)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    img_per_sec_per_chip = batch * steps / dt / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_images_per_sec_per_chip"
+                if not tiny
+                else "resnet56_tiny_train_images_per_sec_per_chip",
+                "value": round(img_per_sec_per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(
+                    img_per_sec_per_chip / REFERENCE_IMG_PER_SEC_PER_CHIP, 4
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -57,8 +57,8 @@ class TFCluster:
         logger.info("feeding training data (epochs=%s)", num_epochs)
         assert self.input_mode == InputMode.SPARK, "train() requires InputMode.SPARK"
         assert dataRDD is not None, "dataRDD is required"
-        assert num_epochs >= 0, "num_epochs cannot be negative"
-        if num_epochs == 0:
+        assert num_epochs is None or num_epochs >= 0, "num_epochs cannot be negative"
+        if not num_epochs:
             # unspecified: feed "many" epochs and rely on the training loop to
             # terminate the feed at its target step count (reference
             # TFCluster.py:88-92 picks the same arbitrary 10)

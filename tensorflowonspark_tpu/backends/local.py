@@ -272,11 +272,15 @@ class LocalSparkContext:
                     )
                 )
         fn_blobs = {}
+        data_blobs = {}  # keyed by id(): epoch-unions repeat the same lists
         for pidx, (data, fns) in enumerate(parts):
             fn_blob = fn_blobs.get(fns)
             if fn_blob is None:
                 fn_blob = fn_blobs[fns] = cloudpickle.dumps(_make_chain(fns))
-            task = (job_id, pidx, fn_blob, cloudpickle.dumps(data))
+            data_blob = data_blobs.get(id(data))
+            if data_blob is None:
+                data_blob = data_blobs[id(data)] = cloudpickle.dumps(data)
+            task = (job_id, pidx, fn_blob, data_blob)
             if targets is not None:
                 self._private_qs[targets[pidx]].put(task)
             else:

@@ -1,0 +1,100 @@
+"""MNIST classifiers — the framework's smoke-test workload.
+
+Capability-parity with the reference's example models: the Keras MLP
+(/root/reference/examples/mnist/keras/mnist_spark.py:27-31 — Flatten,
+Dense(512, relu), Dropout(0.2), Dense(10, softmax)) and a small CNN. Models
+compute in ``dtype`` (bfloat16 on TPU keeps the MXU fed) with float32 params.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from tensorflowonspark_tpu.models import register
+
+
+class MnistMLP(nn.Module):
+    """The reference Keras model, flax-style."""
+
+    hidden: int = 512
+    num_classes: int = 10
+    dropout_rate: float = 0.2
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.astype(self.dtype).reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x).astype(jnp.float32)
+
+
+class MnistCNN(nn.Module):
+    """Conv net variant (for the TENSORFLOW-input-mode examples)."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.astype(self.dtype)
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.reshape((x.shape[0], 28, 28, -1))
+        x = nn.relu(nn.Conv(32, (3, 3), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (3, 3), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x).astype(jnp.float32)
+
+
+@register("mnist_mlp")
+def create_mlp(**cfg):
+    return MnistMLP(**cfg)
+
+
+@register("mnist_cnn")
+def create_cnn(**cfg):
+    return MnistCNN(**cfg)
+
+
+def create_model(kind="mlp", **cfg):
+    return MnistMLP(**cfg) if kind == "mlp" else MnistCNN(**cfg)
+
+
+def make_init_fn(model, sample_shape=(1, 28, 28)):
+    def init(rng):
+        return model.init(rng, jnp.zeros(sample_shape, jnp.float32))
+
+    return init
+
+
+def make_loss_fn(model, dropout_seed=0):
+    """``loss_fn(params, batch)`` for SyncDataParallel; batch keys
+    ``image`` (N,28,28[,1]) float and ``label`` (N,) int."""
+
+    def loss_fn(params, batch):
+        rng = jax.random.fold_in(jax.random.PRNGKey(dropout_seed), batch.get("step", 0))
+        logits = model.apply(
+            {"params": params}, batch["image"], train=True, rngs={"dropout": rng}
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]
+        ).mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+        return loss, {"accuracy": acc}
+
+    return loss_fn
+
+
+def make_predict_fn(model):
+    def predict(params, batch):
+        logits = model.apply({"params": params}, batch["image"], train=False)
+        return jnp.argmax(logits, -1)
+
+    return predict

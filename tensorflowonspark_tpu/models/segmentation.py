@@ -1,0 +1,92 @@
+"""Image segmentation U-Net.
+
+Capability-parity with the reference's segmentation example
+(/root/reference/examples/segmentation/segmentation_spark.py:70-122: a
+MobileNetV2-encoder + pix2pix-upsampler "U-Net" on 128×128×3 images with 3
+output classes). TPU-first: a clean conv U-Net with GroupNorm (no BN state to
+synchronize, friendlier at the small per-chip batch sizes segmentation runs
+at) and bfloat16 compute.
+"""
+
+import functools
+
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from tensorflowonspark_tpu.models import register
+
+
+class ConvBlock(nn.Module):
+    filters: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        x = nn.gelu(nn.GroupNorm(num_groups=8, dtype=self.dtype)(conv(self.filters, (3, 3))(x)))
+        x = nn.gelu(nn.GroupNorm(num_groups=8, dtype=self.dtype)(conv(self.filters, (3, 3))(x)))
+        return x
+
+
+class UNet(nn.Module):
+    """Encoder/decoder with skip connections; depth-4 like the reference's
+    MobileNetV2 feature pyramid (64→4 spatial)."""
+
+    num_classes: int = 3
+    base_filters: int = 32
+    depth: int = 4
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        del train  # no dropout/BN; signature parity with the other models
+        x = x.astype(self.dtype)
+        skips = []
+        for d in range(self.depth):
+            x = ConvBlock(self.base_filters * 2**d, self.dtype, name="enc{}".format(d))(x)
+            skips.append(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = ConvBlock(self.base_filters * 2**self.depth, self.dtype, name="bottleneck")(x)
+        for d in reversed(range(self.depth)):
+            x = nn.ConvTranspose(
+                self.base_filters * 2**d, (2, 2), strides=(2, 2), dtype=self.dtype,
+                name="up{}".format(d),
+            )(x)
+            x = jnp.concatenate([x, skips[d]], axis=-1)
+            x = ConvBlock(self.base_filters * 2**d, self.dtype, name="dec{}".format(d))(x)
+        logits = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype, name="head")(x)
+        return logits.astype(jnp.float32)
+
+
+@register("unet")
+def create_model(**cfg):
+    return UNet(**cfg)
+
+
+def make_init_fn(model, image_size=128, channels=3):
+    def init(rng):
+        return model.init(rng, jnp.zeros((1, image_size, image_size, channels)))
+
+    return init
+
+
+def make_loss_fn(model):
+    """batch: {"image": [N,H,W,C] float, "mask": [N,H,W] int}."""
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["image"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["mask"]
+        ).mean()
+        iou_proxy = jnp.mean(jnp.argmax(logits, -1) == batch["mask"])
+        return loss, {"pixel_accuracy": iou_proxy}
+
+    return loss_fn
+
+
+def make_predict_fn(model):
+    def predict_fn(params, batch):
+        return jnp.argmax(model.apply({"params": params}, batch["image"]), -1)
+
+    return predict_fn

@@ -1,0 +1,231 @@
+"""Decoder-only transformer LM — the long-context flagship model.
+
+No counterpart exists in the reference (its models are CNNs; SURVEY.md §5
+notes sequence parallelism is entirely absent) — this model is the showcase
+for the capabilities the TPU build adds: bfloat16 compute on the MXU, rotary
+positions, and attention that transparently switches to **ring attention**
+over the ``sp`` mesh axis for sequences too long for one chip
+(:mod:`tensorflowonspark_tpu.parallel.ring_attention`).
+
+Sharding: ``param_specs`` gives each weight a PartitionSpec combining tensor
+parallelism (``tp``: attention heads / MLP hidden sharded) with FSDP
+(``fsdp``: remaining large dims), and the model inserts activation sharding
+constraints so XLA keeps activations distributed across dp/sp/tp instead of
+gathering them.
+"""
+
+import dataclasses
+import re
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from tensorflowonspark_tpu.models import register
+from tensorflowonspark_tpu.parallel.ring_attention import (
+    plain_attention,
+    ring_attention_sharded,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 6
+    n_heads: int = 8
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    dtype: str = "float32"  # compute dtype; params stay float32
+    remat: bool = False  # jax.checkpoint each block: FLOPs for HBM
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def _rope(x, positions, base=10000.0):
+    """Rotary position embedding over the last (head) dim; x: [B, L, H, D]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, :, None].astype(jnp.float32) * freqs  # [B, L, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+    mesh: object = None  # jax.sharding.Mesh or None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        dt = cfg.compute_dtype
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.n_heads, cfg.head_dim), axis=-1, use_bias=False, dtype=dt, name=name
+        )
+        q, k, v = dense("q")(x), dense("k")(x), dense("v")(x)  # [B, L, H, D]
+        q = _rope(q, positions)
+        k = _rope(k, positions)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B, H, L, D]
+        if self.mesh is not None and "sp" in self.mesh.axis_names:
+            out = ring_attention_sharded(q, k, v, self.mesh, causal=True)
+        else:
+            out = plain_attention(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3)  # [B, L, H, D]
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), use_bias=False, dtype=dt, name="o"
+        )(out)
+
+
+class Mlp(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        dt = self.cfg.compute_dtype
+        h = nn.Dense(self.cfg.d_ff, use_bias=False, dtype=dt, name="wi")(x)
+        h = nn.gelu(h)
+        return nn.Dense(self.cfg.d_model, use_bias=False, dtype=dt, name="wo")(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    mesh: object = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        x = x + Attention(self.cfg, self.mesh, name="attn")(
+            nn.RMSNorm(dtype=self.cfg.compute_dtype, name="ln1")(x), positions
+        )
+        x = x + Mlp(self.cfg, name="mlp")(
+            nn.RMSNorm(dtype=self.cfg.compute_dtype, name="ln2")(x)
+        )
+        return x
+
+
+class Transformer(nn.Module):
+    cfg: TransformerConfig
+    mesh: object = None
+
+    def _constrain(self, x):
+        """Keep activations sharded batch×seq across the mesh."""
+        if self.mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        names = self.mesh.axis_names
+        batch = tuple(a for a in ("dp", "fsdp") if a in names) or None
+        if batch is not None and len(batch) == 1:
+            batch = batch[0]
+        seq = "sp" if "sp" in names else None
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P(batch, seq, None))
+        )
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        x = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype, name="embed"
+        )(tokens)
+        x = self._constrain(x)
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1])[None, :], tokens.shape
+        )
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = block(cfg, self.mesh, name="layer_{}".format(i))(x, positions)
+            x = self._constrain(x)
+        x = nn.RMSNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.compute_dtype, name="lm_head"
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+#: path-regex → PartitionSpec-template rules for tensor parallelism; dims not
+#: named here fall back to fsdp placement when an fsdp axis exists.
+_TP_RULES = (
+    (r"attn/(q|k|v)/kernel$", ("fsdp", "tp", None)),  # [d_model, H, head_dim]
+    (r"attn/o/kernel$", ("tp", None, "fsdp")),  # [H, head_dim, d_model]
+    (r"mlp/wi/kernel$", ("fsdp", "tp")),  # [d_model, d_ff]
+    (r"mlp/wo/kernel$", ("tp", "fsdp")),  # [d_ff, d_model]
+    (r"embed/embedding$", (None, "fsdp")),  # [vocab, d_model]
+    (r"lm_head/kernel$", ("fsdp", "tp")),  # [d_model, vocab]
+)
+
+
+def param_specs(params, mesh):
+    """PartitionSpecs for the transformer's params over ``mesh``: tp rules
+    above, fsdp for what they leave unnamed, replication for the rest. Axes
+    not present in the mesh are dropped from the specs, so the same rules
+    serve dp-only, dp×tp, fsdp×sp, etc."""
+    from jax.sharding import PartitionSpec as P
+
+    names = set(mesh.axis_names)
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    specs = {}
+    for path, leaf in flat:
+        key = "/".join(
+            p.key if hasattr(p, "key") else str(p) for p in path
+        )
+        spec = None
+        for pattern, template in _TP_RULES:
+            if re.search(pattern, key):
+                spec = P(*(a if a in names else None for a in template))
+                break
+        if spec is None:
+            spec = P(*([None] * leaf.ndim))
+        specs[key] = spec
+
+    def lookup(path, leaf):
+        key = "/".join(p.key if hasattr(p, "key") else str(p) for p in path)
+        return specs[key]
+
+    return jax.tree_util.tree_map_with_path(lookup, params)
+
+
+@register("transformer")
+def create_model(mesh=None, **cfg):
+    return Transformer(TransformerConfig(**cfg), mesh=mesh)
+
+
+def make_init_fn(model, sample_len=16):
+    def init(rng):
+        return model.init(rng, jnp.zeros((1, sample_len), jnp.int32))
+
+    return init
+
+
+def make_loss_fn(model):
+    """Next-token LM loss; batch = {"tokens": int32 [B, L]} (optionally with
+    {"mask": [B, L]} to exclude padding)."""
+
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]
+        logits = model.apply({"params": params}, tokens[:, :-1])
+        targets = tokens[:, 1:]
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        mask = batch.get("mask")
+        if mask is not None:
+            mask = mask[:, 1:]
+            loss = (losses * mask).sum() / jnp.maximum(mask.sum(), 1)
+        else:
+            loss = losses.mean()
+        return loss, {"perplexity": jnp.exp(loss)}
+
+    return loss_fn

@@ -20,23 +20,36 @@ Canonical mesh axes (any subset may be present, always in this order):
 =======  =====================================================================
 """
 
-from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
-    AXIS_ORDER,
-    build_mesh,
-    local_mesh,
-    mesh_shape,
-)
-from tensorflowonspark_tpu.parallel.sharding import (  # noqa: F401
-    batch_sharding,
-    batch_spec,
-    data_axes,
-    fsdp_param_specs,
-    replicated,
-    shard_batch,
-    shard_params,
-)
-from tensorflowonspark_tpu.parallel import collectives  # noqa: F401
-from tensorflowonspark_tpu.parallel.ring_attention import (  # noqa: F401
-    ring_attention,
-    ring_attention_sharded,
-)
+# Lazy re-exports (PEP 562): importing this package must not import jax —
+# executor/driver processes stay jax-free so the platform (TPU vs CPU) is
+# decided by the jax child process, not by whoever imported the package first.
+_EXPORTS = {
+    "AXIS_ORDER": "mesh",
+    "build_mesh": "mesh",
+    "local_mesh": "mesh",
+    "mesh_shape": "mesh",
+    "batch_sharding": "sharding",
+    "batch_spec": "sharding",
+    "data_axes": "sharding",
+    "fsdp_param_specs": "sharding",
+    "replicated": "sharding",
+    "shard_batch": "sharding",
+    "shard_params": "sharding",
+    "collectives": None,
+    "ring_attention": "ring_attention",
+    "ring_attention_sharded": "ring_attention",
+}
+
+
+def __getattr__(name):
+    import importlib
+
+    if name not in _EXPORTS:
+        raise AttributeError(name)
+    submodule = _EXPORTS[name] or name
+    mod = importlib.import_module("tensorflowonspark_tpu.parallel." + submodule)
+    return mod if _EXPORTS[name] is None else getattr(mod, name)
+
+
+def __dir__():
+    return sorted(_EXPORTS)

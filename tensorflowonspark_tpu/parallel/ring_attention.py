@@ -92,12 +92,18 @@ def ring_attention_sharded(q, k, v, mesh, causal=False, scale=None, axis="sp"):
 
     from tensorflowonspark_tpu.parallel.sharding import data_axes
 
-    if axis not in mesh.axis_names or dict(
-        zip(mesh.axis_names, mesh.devices.shape)
-    )[axis] == 1:
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if axis not in sizes or sizes[axis] == 1:
         return plain_attention(q, k, v, causal=causal, scale=scale)
 
     batch = data_axes(mesh)
+    batch_div = 1
+    for a in batch:
+        batch_div *= sizes[a]
+    if q.shape[0] % batch_div or q.shape[2] % sizes[axis] or k.shape[2] % sizes[axis]:
+        # shapes that don't divide the mesh (e.g. module.init on a [1, small]
+        # probe batch) fall back to the single-block path — same math
+        return plain_attention(q, k, v, causal=causal, scale=scale)
     bspec = batch if len(batch) > 1 else (batch[0] if batch else None)
     spec = P(bspec, None, axis, None)
     fn = jax.shard_map(

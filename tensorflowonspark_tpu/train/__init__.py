@@ -5,3 +5,28 @@ This package is the replacement for the reference's reliance on
 parallelism is a pjit program over a ``jax.sharding.Mesh`` with XLA collectives
 over ICI, and checkpoint/resume is orbax.
 """
+
+# Lazy re-exports (PEP 562): keep `import tensorflowonspark_tpu.train` (and
+# `from ... import checkpoint`) jax-free; jax loads only when a strategy or
+# checkpoint function is actually touched.
+_EXPORTS = {
+    "SyncDataParallel": "strategy",
+    "TrainState": "strategy",
+    "steps_per_worker": "strategy",
+    "checkpoint": None,
+    "strategy": None,
+}
+
+
+def __getattr__(name):
+    import importlib
+
+    if name not in _EXPORTS:
+        raise AttributeError(name)
+    submodule = _EXPORTS[name] or name
+    mod = importlib.import_module("tensorflowonspark_tpu.train." + submodule)
+    return mod if _EXPORTS[name] is None else getattr(mod, name)
+
+
+def __dir__():
+    return sorted(_EXPORTS)

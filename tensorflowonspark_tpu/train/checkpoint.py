@@ -21,6 +21,41 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+#: marker key distinguishing a saved TrainState from a user's plain dict that
+#: happens to have step/params/opt_state keys
+_STATE_SENTINEL = "__train_state__"
+
+
+def _to_saveable(state):
+    """TrainState saves as a named dict so a target-less restore is
+    self-describing (a bare custom pytree would come back as a list)."""
+    from tensorflowonspark_tpu.train.strategy import TrainState
+
+    if isinstance(state, TrainState):
+        out = {
+            _STATE_SENTINEL: 1,
+            "step": state.step,
+            "params": state.params,
+            "opt_state": state.opt_state,
+        }
+        if state.model_state:
+            out["model_state"] = state.model_state
+        return out
+    return state
+
+
+def _from_saved(tree, target):
+    from tensorflowonspark_tpu.train.strategy import TrainState
+
+    if isinstance(target, TrainState) or (
+        target is None and isinstance(tree, dict) and _STATE_SENTINEL in tree
+    ):
+        return TrainState(
+            tree["step"], tree["params"], tree["opt_state"], tree.get("model_state")
+        )
+    return tree
+
+
 def save_checkpoint(path, state, force=True):
     """Save a pytree ``state`` (params/opt-state/step) to ``path``.
 
@@ -31,7 +66,7 @@ def save_checkpoint(path, state, force=True):
     """
     path = os.path.abspath(os.path.expanduser(path))
     ckptr = _checkpointer()
-    ckptr.save(path, state, force=force)
+    ckptr.save(path, _to_saveable(state), force=force)
     ckptr.wait_until_finished()
     logger.info("saved checkpoint to %s", path)
     return path
@@ -41,9 +76,14 @@ def restore_checkpoint(path, target=None):
     """Restore a pytree from ``path``; ``target`` gives structure/shardings."""
     path = os.path.abspath(os.path.expanduser(path))
     ckptr = _checkpointer()
-    state = ckptr.restore(path, target) if target is not None else ckptr.restore(path)
+    saveable_target = _to_saveable(target) if target is not None else None
+    state = (
+        ckptr.restore(path, saveable_target)
+        if saveable_target is not None
+        else ckptr.restore(path)
+    )
     logger.info("restored checkpoint from %s", path)
-    return state
+    return _from_saved(state, target)
 
 
 def latest_checkpoint(model_dir):

@@ -1,0 +1,235 @@
+"""Training strategies: the MultiWorkerMirroredStrategy / NCCL replacement.
+
+The reference delegated distributed training to TF strategies chosen by user
+code (`MultiWorkerMirroredStrategy` in every TF2 example, e.g.
+/root/reference/examples/mnist/keras/mnist_spark.py:11;
+`ParameterServerStrategy` for async, mnist_spark_streaming.py:84-89). Here the
+strategy is a thin object that owns a mesh and compiles the user's loss into a
+sharded train step: batches shard over the data axes, params replicate (pure
+DP) or shard along ``fsdp`` (ZeRO-3), and XLA derives the gradient all-reduce /
+reduce-scatter over ICI from the shardings — there is no collective to call by
+hand and no PS; sync DP over ICI serves both of the reference's modes
+(SURVEY.md §2.6).
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.parallel import (
+    batch_sharding,
+    build_mesh,
+    fsdp_param_specs,
+    replicated,
+    shard_batch,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class TrainState:
+    """Minimal train-state pytree: step / params / opt_state / model_state.
+
+    ``model_state`` carries non-trained variable collections (e.g. BatchNorm
+    ``batch_stats`` — note that under pjit the batch-mean/var are computed over
+    the *global* sharded batch, so cross-replica "sync BN" is automatic, unlike
+    the reference's per-replica BN under MultiWorkerMirroredStrategy).
+
+    Registered as a pytree so it flows through jit/grad; deliberately not
+    carrying apply_fn/tx (functions don't belong in a sharded, checkpointable
+    pytree — orbax saves exactly this tuple).
+    """
+
+    def __init__(self, step, params, opt_state, model_state=None):
+        self.step = step
+        self.params = params
+        self.opt_state = opt_state
+        self.model_state = {} if model_state is None else model_state
+
+    def replace(self, **kw):
+        return TrainState(
+            kw.get("step", self.step),
+            kw.get("params", self.params),
+            kw.get("opt_state", self.opt_state),
+            kw.get("model_state", self.model_state),
+        )
+
+    def tree_flatten(self):
+        return (self.step, self.params, self.opt_state, self.model_state), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    TrainState, TrainState.tree_flatten, TrainState.tree_unflatten
+)
+
+
+class SyncDataParallel:
+    """Synchronous data parallelism (optionally fully-sharded) over a mesh.
+
+    ``fsdp=False``: params/opt-state replicated, batch sharded over ``dp`` —
+    the exact capability of the reference's collective all-reduce path.
+    ``fsdp=True``: params/opt-state sharded along the ``fsdp`` axis (ZeRO-3),
+    which the reference could not express at all.
+
+    Usage inside ``main_fun(args, ctx)``::
+
+        strategy = SyncDataParallel(ctx.mesh({"dp": -1}))
+        state = strategy.create_state(model_init, optimizer, rng, sample_batch)
+        step = strategy.compile_train_step(loss_fn, optimizer)
+        for batch in batches:
+            state, metrics = step(state, strategy.shard_batch(batch))
+    """
+
+    def __init__(self, mesh=None, fsdp=False, min_weight_size=2**14, param_spec_fn=None):
+        """``param_spec_fn(params_shape, mesh) -> PartitionSpec pytree`` lets a
+        model supply its own placement rules (e.g.
+        :func:`tensorflowonspark_tpu.models.transformer.param_specs` for
+        tensor parallelism); default placement is replicate (pure DP) or the
+        generic FSDP rules."""
+        self.mesh = mesh if mesh is not None else build_mesh()
+        self.fsdp = fsdp
+        self.min_weight_size = min_weight_size
+        self.param_spec_fn = param_spec_fn
+        if fsdp and "fsdp" not in self.mesh.axis_names:
+            raise ValueError(
+                "fsdp=True requires a mesh with an 'fsdp' axis; got {}".format(
+                    self.mesh.axis_names
+                )
+            )
+
+    # -- placement ------------------------------------------------------------
+
+    def param_shardings(self, params_shape):
+        """NamedShardings for a params pytree (from shapes or real arrays)."""
+        from jax.sharding import NamedSharding
+
+        if self.param_spec_fn is not None:
+            specs = self.param_spec_fn(params_shape, self.mesh)
+            return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs)
+        if self.fsdp:
+            specs = fsdp_param_specs(
+                params_shape, self.mesh, min_weight_size=self.min_weight_size
+            )
+            return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs)
+        rep = replicated(self.mesh)
+        return jax.tree.map(lambda _: rep, params_shape)
+
+    def shard_batch(self, batch):
+        return shard_batch(batch, self.mesh)
+
+    # -- state ----------------------------------------------------------------
+
+    @staticmethod
+    def _split_variables(variables):
+        """flax ``init`` returns {'params': ..., 'batch_stats': ..., ...};
+        split into (params, model_state). A bare pytree is all params."""
+        if isinstance(variables, dict) and "params" in variables:
+            params = variables["params"]
+            model_state = {k: v for k, v in variables.items() if k != "params"}
+            return params, model_state
+        return variables, {}
+
+    def create_state(self, init_fn, optimizer, *init_args):
+        """Build a sharded TrainState without ever materializing an unsharded
+        copy: params/opt-state are initialized *inside* jit with the target
+        shardings as out_shardings, so each device only ever allocates its
+        shard (critical for FSDP models larger than one host's memory).
+
+        ``init_fn(*init_args)`` returns either a bare params pytree or a flax
+        variables dict (``{'params': ..., 'batch_stats': ...}``).
+        """
+
+        def _init():
+            params, model_state = self._split_variables(init_fn(*init_args))
+            return TrainState(
+                jnp.zeros((), jnp.int32), params, optimizer.init(params), model_state
+            )
+
+        state_shape = jax.eval_shape(_init)
+        shardings = TrainState(
+            replicated(self.mesh),
+            self.param_shardings(state_shape.params),
+            self._opt_shardings(state_shape),
+            jax.tree.map(lambda _: replicated(self.mesh), state_shape.model_state),
+        )
+        return jax.jit(_init, out_shardings=shardings)()
+
+    def _opt_shardings(self, state_shape):
+        """Opt-state shardings: any leaf whose shape matches a param leaf gets
+        that param's sharding (Adam moments mirror params); everything else
+        (counts, scalars) replicates."""
+        param_shardings = self.param_shardings(state_shape.params)
+        by_shape = {}
+        for p_leaf, s in zip(
+            jax.tree.leaves(state_shape.params), jax.tree.leaves(param_shardings)
+        ):
+            by_shape.setdefault((p_leaf.shape, p_leaf.dtype), s)
+        rep = replicated(self.mesh)
+        return jax.tree.map(
+            lambda leaf: by_shape.get((leaf.shape, leaf.dtype), rep),
+            state_shape.opt_state,
+        )
+
+    # -- compiled steps --------------------------------------------------------
+
+    def compile_train_step(self, loss_fn, optimizer, has_aux=False, mutable=False, donate=True):
+        """Compile a loss into a sharded ``step(state, batch) -> (state, metrics)``.
+
+        * ``mutable=False``: ``loss_fn(params, batch) -> loss`` or
+          ``(loss, aux_metrics)`` with ``has_aux=True``.
+        * ``mutable=True`` (models with batch_stats etc.):
+          ``loss_fn(params, model_state, batch) -> (loss, (new_model_state,
+          aux_metrics))`` — ``has_aux`` is implied.
+
+        The gradient all-reduce (pure DP) or reduce-scatter+all-gather (FSDP)
+        is inserted by XLA from the shardings — the moral equivalent of the
+        reference's `all_reduce_alg`/NCCL configuration, with zero user code.
+        """
+        import optax
+
+        def step(state, batch):
+            if mutable:
+                (loss, (model_state, aux)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(state.params, state.model_state, batch)
+            else:
+                out = jax.value_and_grad(loss_fn, has_aux=has_aux)(state.params, batch)
+                (loss, aux), grads = out if has_aux else ((out[0], None), out[1])
+                model_state = state.model_state
+            updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            new_state = TrainState(state.step + 1, params, opt_state, model_state)
+            metrics = {"loss": loss, "step": new_state.step}
+            if aux:
+                metrics.update(aux)
+            return new_state, metrics
+
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    def compile_eval_step(self, metric_fn):
+        """Compile ``metric_fn(params, batch) -> metrics`` for sharded eval."""
+        return jax.jit(metric_fn)
+
+    def compile_predict_step(self, apply_fn):
+        """Compile ``apply_fn(params, batch) -> predictions``; outputs gather
+        to fully-addressable arrays for host-side result queues."""
+        return jax.jit(apply_fn, out_shardings=replicated(self.mesh))
+
+
+def steps_per_worker(total_examples, batch_size, num_workers, safety=0.9):
+    """Per-worker step budget for InputMode.SPARK feeding.
+
+    Spark partitions are uneven, so a worker that demands exactly
+    ``total/batch/workers`` steps can starve at the epoch tail and hang the
+    collective. The reference buried this as example folklore — "limit
+    steps to ~90% of expected to account for uneven partitions"
+    (/root/reference/examples/mnist/keras/mnist_spark.py:58-64); here it is
+    the documented helper.
+    """
+    per_worker = total_examples // (batch_size * max(num_workers, 1))
+    return max(1, int(per_worker * safety))
