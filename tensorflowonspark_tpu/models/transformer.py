@@ -39,6 +39,9 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: str = "float32"  # compute dtype; params stay float32
     remat: bool = False  # jax.checkpoint each block: FLOPs for HBM
+    #: "auto" — ring over sp when the mesh has it, else the pallas flash
+    #: kernel on TPU, else plain XLA attention; or force "flash"/"plain"
+    attention: str = "auto"
 
     @property
     def head_dim(self):
@@ -63,6 +66,42 @@ def _rope(x, positions, base=10000.0):
     ).astype(x.dtype)
 
 
+_ATTENTION_IMPLS = ("auto", "flash", "plain", "ring")
+
+
+def _dispatch_attention(q, k, v, impl, mesh):
+    """Pick the attention path. ``auto``: ring over ``sp`` when the mesh
+    shards the sequence, else the pallas flash kernel on TPU, else plain XLA
+    attention. Forcing ``plain``/``flash``/``ring`` always wins (``plain`` on
+    an sp mesh is the debugging escape hatch — correct, just unsharded math).
+    """
+    if impl not in _ATTENTION_IMPLS:
+        raise ValueError(
+            "unknown attention impl {!r}; expected one of {}".format(impl, _ATTENTION_IMPLS)
+        )
+    if impl == "plain":
+        return plain_attention(q, k, v, causal=True)
+    has_sp = mesh is not None and "sp" in mesh.axis_names
+    if impl == "ring" or (impl == "auto" and has_sp):
+        return ring_attention_sharded(q, k, v, mesh, causal=True)
+    if impl == "flash" or jax.default_backend() == "tpu":
+        from tensorflowonspark_tpu.ops.flash_attention import flash_attention
+
+        seq = q.shape[2]
+        pad = (-seq) % 128 if seq > 512 else 0
+        if pad:
+            # causal masking means queries < seq never attend to the zero
+            # padding appended after them, so pad-run-slice is exact
+            q, k, v = (
+                jnp.pad(t, ((0, 0), (0, 0), (0, pad), (0, 0))) for t in (q, k, v)
+            )
+        out = flash_attention(
+            q, k, v, causal=True, interpret=jax.default_backend() != "tpu"
+        )
+        return out[:, :, :seq] if pad else out
+    return plain_attention(q, k, v, causal=True)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
     mesh: object = None  # jax.sharding.Mesh or None
@@ -78,10 +117,7 @@ class Attention(nn.Module):
         q = _rope(q, positions)
         k = _rope(k, positions)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B, H, L, D]
-        if self.mesh is not None and "sp" in self.mesh.axis_names:
-            out = ring_attention_sharded(q, k, v, self.mesh, causal=True)
-        else:
-            out = plain_attention(q, k, v, causal=True)
+        out = _dispatch_attention(q, k, v, cfg.attention, self.mesh)
         out = out.transpose(0, 2, 1, 3)  # [B, L, H, D]
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), use_bias=False, dtype=dt, name="o"

@@ -1,0 +1,342 @@
+"""Flash attention as pallas TPU kernels, with a full custom VJP.
+
+Blockwise attention that never materializes the [L, L] score matrix: the
+forward streams K/V blocks through VMEM accumulating an online softmax
+(running max ``m``, denominator ``l``, weighted values ``acc``); the backward
+recomputes probabilities per block from the saved log-sum-exp and accumulates
+dq / dk / dv — three matmul-dominated kernels that keep the MXU busy while
+HBM traffic stays O(L·D).
+
+This is the single-device analogue of
+:mod:`tensorflowonspark_tpu.parallel.ring_attention` (same math, blocks
+streamed from local HBM instead of rotated over ICI). ``interpret=True`` runs
+the kernels on CPU for tests.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+# tuned on v5e (L=4096, d=64, bf16): 512/512 runs ~1.3x faster than XLA's
+# fused attention; 128/128 only ties it
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+
+#: row-statistics (lse/delta) are stored [BH, L, _STAT_W]: TPU block shapes
+#: need a tileable trailing dim, and a trailing dim equal to the full array
+#: dim is allowed, so 8 lanes is the cheapest legal width
+_STAT_W = 8
+
+
+def _causal_mask(s, iq, ik, block_q, block_k):
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_BIG)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *, scale, causal, block_q, block_k):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m[:] = jnp.full_like(m, _NEG_BIG)
+        l[:] = jnp.zeros_like(l)
+
+    def _block():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _causal_mask(s, iq, ik, block_q, block_k)
+        m_new = jnp.maximum(m[:], jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m[:] - m_new)
+        p = jnp.exp(s - m_new)
+        l[:] = l[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m[:] = m_new
+
+    if causal:
+        # skip blocks strictly above the diagonal
+        @pl.when(ik * block_k <= iq * block_q + (block_q - 1))
+        def _():
+            _block()
+    else:
+        _block()
+
+    @pl.when(ik == pl.num_programs(2) - 1)
+    def _finish():
+        denom = jnp.maximum(l[:], 1e-30)
+        o_ref[0] = (acc[:] / denom).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(m[:] + jnp.log(denom), (l.shape[0], _STAT_W))
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc, *, scale, causal, block_q, block_k):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    def _block():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _causal_mask(s, iq, ik, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        acc[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        @pl.when(ik * block_k <= iq * block_q + (block_q - 1))
+        def _():
+            _block()
+    else:
+        _block()
+
+    @pl.when(ik == pl.num_programs(2) - 1)
+    def _finish():
+        dq_ref[0] = acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, block_q, block_k):
+    ik, iq = pl.program_id(1), pl.program_id(2)  # note: kv outer, q inner
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _block():
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            s = _causal_mask(s, iq, ik, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])  # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, :1]) * scale  # [bq, bk]
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # q blocks strictly above this kv block contribute nothing
+        @pl.when(iq * block_q + (block_q - 1) >= ik * block_k)
+        def _():
+            _block()
+    else:
+        _block()
+
+    @pl.when(iq == pl.num_programs(2) - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _specs(block_rows, head_dim, outer_fixed=True):
+    """BlockSpec over [BH, L, D] arrays: (1, block_rows, D) blocks; the row
+    index comes from grid dim 1 when ``outer_fixed`` else grid dim 2."""
+    if outer_fixed:
+        return pl.BlockSpec((1, block_rows, head_dim), lambda b, i, j: (b, i, 0))
+    return pl.BlockSpec((1, block_rows, head_dim), lambda b, i, j: (b, j, 0))
+
+
+def _row_specs(block_rows, outer_fixed=True):
+    if outer_fixed:
+        return pl.BlockSpec((1, block_rows, _STAT_W), lambda b, i, j: (b, i, 0))
+    return pl.BlockSpec((1, block_rows, _STAT_W), lambda b, i, j: (b, j, 0))
+
+
+def _pick_block(seq, preferred):
+    """Largest power-of-two block ≤ preferred that divides seq (whole-array
+    block for short sequences); pallas pads ragged trailing blocks with
+    garbage, so blocks must tile the sequence exactly."""
+    if seq <= preferred:
+        return seq
+    b = preferred
+    while b >= 8:  # 8 = minimum sublane tile
+        if seq % b == 0:
+            return b
+        b //= 2
+    raise ValueError(
+        "sequence length {} has no 8..{} block divisor; pad the sequence "
+        "or use plain attention".format(seq, preferred)
+    )
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    bh, l_q, d = q.shape
+    l_k = k.shape[1]
+    block_q = _pick_block(l_q, block_q)
+    block_k = _pick_block(l_k, block_k)
+    grid = (bh, pl.cdiv(l_q, block_q), pl.cdiv(l_k, block_k))
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            _specs(block_q, d, True),
+            _specs(block_k, d, False),
+            _specs(block_k, d, False),
+        ],
+        out_specs=[_specs(block_q, d, True), _row_specs(block_q, True)],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, l_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, l_q, _STAT_W), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _compiler_params(interpret):
+    """batch/q-block grid dims run in any order; only the kv dim carries the
+    accumulator, so mark it 'arbitrary' and the rest 'parallel' for pipelining."""
+    if interpret:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")
+    )
+
+
+def _flash_bwd(q, k, v, do, o, lse, scale, causal, block_q, block_k, interpret):
+    bh, l_q, d = q.shape
+    l_k = k.shape[1]
+    block_q = _pick_block(l_q, block_q)
+    block_k = _pick_block(l_k, block_k)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, :, None], (bh, l_q, _STAT_W))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        ),
+        grid=(bh, pl.cdiv(l_q, block_q), pl.cdiv(l_k, block_k)),
+        in_specs=[
+            _specs(block_q, d, True),
+            _specs(block_k, d, False),
+            _specs(block_k, d, False),
+            _specs(block_q, d, True),
+            _row_specs(block_q, True),
+            _row_specs(block_q, True),
+        ],
+        out_specs=_specs(block_q, d, True),
+        out_shape=jax.ShapeDtypeStruct((bh, l_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        ),
+        grid=(bh, pl.cdiv(l_k, block_k), pl.cdiv(l_q, block_q)),
+        in_specs=[
+            _specs(block_q, d, False),  # q indexed by inner grid dim
+            _specs(block_k, d, True),  # k fixed per outer step
+            _specs(block_k, d, True),
+            _specs(block_q, d, False),
+            _row_specs(block_q, False),
+            _row_specs(block_q, False),
+        ],
+        out_specs=[_specs(block_k, d, True), _specs(block_k, d, True)],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, l_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, l_k, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(interpret),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_bhld(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_attention_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_attention_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, do, o, lse, scale, causal, block_q, block_k, interpret)
+
+
+_flash_attention_bhld.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def flash_attention(
+    q, k, v, causal=False, scale=None,
+    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K, interpret=False,
+):
+    """Flash attention over ``[batch, heads, seq, head_dim]`` arrays.
+
+    Drop-in replacement for
+    :func:`tensorflowonspark_tpu.parallel.ring_attention.plain_attention`
+    with O(L·D) memory. Sequence lengths must divide into the block sizes
+    (pad upstream; the transformer pads its own inputs).
+    """
+    b, h, l_q, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    merge = lambda t: t.reshape(b * h, t.shape[2], d)  # noqa: E731
+    o = _flash_attention_bhld(
+        merge(q), merge(k), merge(v), float(scale), bool(causal),
+        int(block_q), int(block_k), bool(interpret),
+    )
+    return o.reshape(b, h, l_q, d)

@@ -182,6 +182,50 @@ class TestTransformer:
             np.asarray(out_plain), np.asarray(out_ring), atol=3e-5
         )
 
+    def test_flash_attention_impl_matches_plain(self):
+        """attention='flash' (interpret on CPU) must match the plain path."""
+        cfg = dict(vocab_size=64, d_model=32, n_layers=1, n_heads=4, d_ff=64)
+        model_flash = transformer.create_model(attention="flash", **cfg)
+        model_plain = transformer.create_model(attention="plain", **cfg)
+        tokens = jnp.asarray(np.random.default_rng(3).integers(0, 64, (2, 128)))
+        variables = model_plain.init(jax.random.PRNGKey(0), tokens)
+        out_plain = model_plain.apply(variables, tokens)
+        out_flash = model_flash.apply(variables, tokens)
+        np.testing.assert_allclose(
+            np.asarray(out_plain), np.asarray(out_flash), atol=3e-5
+        )
+
+    def test_flash_pads_odd_training_lengths(self):
+        """make_loss_fn slices tokens[:, :-1] producing odd seq lengths; the
+        flash path must pad-and-slice, matching plain exactly (causality)."""
+        cfg = dict(vocab_size=64, d_model=32, n_layers=1, n_heads=4, d_ff=64)
+        model_flash = transformer.create_model(attention="flash", **cfg)
+        model_plain = transformer.create_model(attention="plain", **cfg)
+        tokens = jnp.asarray(np.random.default_rng(5).integers(0, 64, (1, 515)))
+        variables = model_plain.init(jax.random.PRNGKey(0), tokens)
+        np.testing.assert_allclose(
+            np.asarray(model_plain.apply(variables, tokens)),
+            np.asarray(model_flash.apply(variables, tokens)),
+            atol=3e-5,
+        )
+
+    def test_unknown_attention_impl_raises(self):
+        model = transformer.create_model(
+            attention="flsh", vocab_size=16, d_model=8, n_layers=1, n_heads=2, d_ff=16
+        )
+        with pytest.raises(ValueError, match="unknown attention impl"):
+            model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+    def test_forced_plain_on_sp_mesh(self):
+        """attention='plain' must win over the mesh's sp axis (debug escape)."""
+        mesh = parallel.build_mesh({"sp": 8})
+        cfg = dict(vocab_size=32, d_model=16, n_layers=1, n_heads=2, d_ff=32)
+        model = transformer.create_model(mesh=mesh, attention="plain", **cfg)
+        tokens = jnp.asarray(np.random.default_rng(6).integers(0, 32, (1, 16)))
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+        out = model.apply(variables, tokens)
+        assert np.isfinite(np.asarray(out)).all()
+
     def test_param_specs_tp_rules(self):
         mesh = parallel.build_mesh({"fsdp": 2, "tp": 4})
         model = transformer.create_model(
