@@ -1,0 +1,88 @@
+"""Run N independent single-node instances in parallel — no cluster, no
+reservation server.
+
+Capability-parity with /root/reference/tensorflowonspark/TFParallel.py
+(Spark barrier execution for parallel single-node inference,
+TFParallel.py:17-64): each executor gets a synthetic
+:class:`~tensorflowonspark_tpu.TFSparkNode.TFNodeContext` (executor id from
+the task's partition index, ``num_workers`` = parallelism, no manager/feed
+plane) and runs the user function in a forked jax child so libtpu's
+process-owns-chips rule holds and chips free up when the task ends.
+"""
+
+import logging
+import os
+import traceback
+
+from tensorflowonspark_tpu import TFSparkNode, tpu_info
+
+logger = logging.getLogger(__name__)
+
+_mp = __import__("multiprocessing").get_context("fork")
+
+
+class _ParallelTask:
+    def __init__(self, fn, tf_args, num_executors, env=None):
+        self.fn = fn
+        self.tf_args = tf_args
+        self.num_executors = num_executors
+        self.env = dict(env or {})
+
+    def __call__(self, iterator):
+        executor_id = None
+        for i in iterator:
+            executor_id = i if not isinstance(i, (list, tuple)) else i[0]
+        if executor_id is None:
+            return []
+        ctx = TFSparkNode.TFNodeContext(
+            executor_id=executor_id,
+            job_name="worker",
+            task_index=executor_id,
+            cluster_spec={"worker": ["localhost"] * self.num_executors},
+            defaultFS="file://",
+            working_dir=os.getcwd(),
+        )
+
+        # partition this host's chips across co-resident instances — the
+        # reference placed workers on GPUs by local index (gpu_info.py:102);
+        # without this, concurrent children would each claim ALL chips and
+        # collide on libtpu's process-owns-chips rule
+        chip_ids = None
+        n_chips = tpu_info.detect_local_chips()
+        if n_chips and self.env.get("JAX_PLATFORMS") != "cpu":
+            per = max(1, n_chips // self.num_executors)
+            start = (executor_id * per) % n_chips
+            chip_ids = list(range(start, min(start + per, n_chips)))
+
+        def _entry():
+            try:
+                os.environ.update(self.env)
+                os.environ.update(
+                    tpu_info.visibility_env(
+                        chip_ids=chip_ids, platform=self.env.get("JAX_PLATFORMS")
+                    )
+                )
+                self.fn(self.tf_args, ctx)
+            except BaseException:
+                logger.error("TFParallel fn failed:\n%s", traceback.format_exc())
+                raise SystemExit(1)
+
+        child = _mp.Process(target=_entry, name="jax-parallel-{}".format(executor_id))
+        child.start()
+        child.join()
+        if child.exitcode != 0:
+            raise RuntimeError(
+                "TFParallel instance {} failed (exit {})".format(executor_id, child.exitcode)
+            )
+        return [executor_id]
+
+
+def run(sc, map_fn, tf_args, num_executors, env=None):
+    """Run ``map_fn(tf_args, ctx)`` as ``num_executors`` independent instances
+    (reference TFParallel.run, TFParallel.py:17). Returns the executor ids
+    that completed."""
+    kwargs = {"pin_to_executors": True} if getattr(sc, "PIN_SUPPORTED", False) else {}
+    rdd = sc.parallelize(range(num_executors), num_executors, **kwargs)
+    if hasattr(rdd, "barrier"):  # real Spark: barrier execution mode
+        rdd = rdd.barrier()
+    return rdd.mapPartitions(_ParallelTask(map_fn, tf_args, num_executors, env)).collect()

@@ -64,7 +64,7 @@ def _executor_main(executor_id, workdir, private_q, shared_q, result_q, stop_ev)
         try:
             fn = cloudpickle.loads(fn_blob)
             data = cloudpickle.loads(data_blob)
-            result = fn(iter(data))
+            result = fn(iter(data), pidx)
             payload = cloudpickle.dumps(list(result) if result is not None else None)
             result_q.put((job_id, pidx, executor_id, "ok", payload))
         except BaseException:
@@ -124,6 +124,11 @@ class LocalRDD:
         rdd._pinned = self._pinned
         return rdd
 
+    def mapPartitionsWithIndex(self, fn):
+        """``fn(partition_index, iterator)`` like pyspark's."""
+        fn._wants_index = True
+        return self.mapPartitions(fn)
+
     def map(self, fn):
         def _mapper(it, _fn=fn):
             return (_fn(x) for x in it)
@@ -161,12 +166,43 @@ class LocalRDD:
 
 
 def _make_chain(fns):
-    def _chain(it, _fns=fns):
+    def _chain(it, pidx, _fns=fns):
         for f in _fns:
-            it = f(it)
+            it = f(pidx, it) if getattr(f, "_wants_index", False) else f(it)
         return it if it is not None else []
 
     return _chain
+
+
+class LocalDataFrame:
+    """Minimal columnar view over a LocalRDD of row tuples — just enough
+    DataFrame surface for the ML pipeline layer (select/columns/rdd/collect),
+    mirroring how the reference pipeline uses Spark DataFrames
+    (pipeline.py:411-413 ``dataset.select(cols).rdd``)."""
+
+    def __init__(self, rdd, columns):
+        self._rdd = rdd
+        self.columns = list(columns)
+
+    def select(self, *cols):
+        if len(cols) == 1 and isinstance(cols[0], (list, tuple)):
+            cols = tuple(cols[0])
+        idx = [self.columns.index(c) for c in cols]
+
+        def _project(it, _idx=tuple(idx)):
+            return (tuple(row[i] for i in _idx) for row in it)
+
+        return LocalDataFrame(self._rdd.mapPartitions(_project), cols)
+
+    @property
+    def rdd(self):
+        return self._rdd
+
+    def collect(self):
+        return self._rdd.collect()
+
+    def count(self):
+        return self._rdd.count()
 
 
 class LocalSparkContext:
@@ -233,6 +269,11 @@ class LocalSparkContext:
         for r in rdds[1:]:
             out = out.union(r)
         return out
+
+    def createDataFrame(self, data, columns, numSlices=None):
+        """Rows (tuples/lists) + column names → LocalDataFrame."""
+        rows = [tuple(r) for r in data]
+        return LocalDataFrame(self.parallelize(rows, numSlices), columns)
 
     def stop(self, cleanup=True):
         self._stop_ev.set()

@@ -1,0 +1,45 @@
+"""Version/platform compatibility shims.
+
+Capability-parity with /root/reference/tensorflowonspark/compat.py, whose
+three shims smoothed over TF 2.0/2.1 differences. The TPU-native analogues:
+
+* ``export_saved_model`` — the chief-vs-worker export dance
+  (reference compat.py:10-17: workers dumped to a throwaway dir) is
+  unnecessary with orbax multi-host saves; kept for drop-in source compat.
+* ``disable_auto_shard`` — a tf.data concept with no jax equivalent; no-op.
+* ``is_gpu_available`` → TPU probe.
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def export_saved_model(model_or_state, export_dir, is_chief=False):
+    """Reference compat.py:10-17. Delegates to the checkpoint layer's export,
+    where EVERY process participates (orbax multi-host saves are collective —
+    a chief-only save would deadlock the sync barrier); ``is_chief`` is
+    accepted purely for source compatibility."""
+    from tensorflowonspark_tpu.train import checkpoint
+
+    return checkpoint.export_saved_model(None, export_dir, model_or_state, is_chief=is_chief)
+
+
+def disable_auto_shard(options):
+    """Reference compat.py:20-26; auto-sharding is a tf.data policy that does
+    not exist in the jax input path — explicit shard placement replaces it."""
+    del options
+
+
+def is_gpu_available():
+    """Reference compat.py:27-31 probed GPUs; the equivalent question on this
+    stack is whether TPU chips are attached."""
+    from tensorflowonspark_tpu import tpu_info
+
+    return tpu_info.is_tpu_available()
+
+
+def is_tpu_available():
+    from tensorflowonspark_tpu import tpu_info
+
+    return tpu_info.is_tpu_available()

@@ -1,0 +1,267 @@
+"""TFRecord file IO + tf.train.Example wire format, dependency-free.
+
+The reference delegated TFRecord IO to a prebuilt Hadoop InputFormat jar
+(/root/reference/lib/tensorflow-hadoop-1.0-SNAPSHOT.jar, driven by
+dfutil.py:39,63) and the Example proto to TensorFlow. Here both are
+implemented directly: the TFRecord framing (length + masked-crc32c records)
+and a minimal protobuf codec for the fixed ``Example`` schema — so the TPU
+framework reads/writes the interchange format without a TensorFlow or JVM
+dependency. (A C++ reader for the bulk-ingest hot path lives in
+``native/``.)
+
+Wire format reference: tensorflow/core/lib/io/record_writer.h (framing) and
+tensorflow/core/example/example.proto, feature.proto (schema).
+"""
+
+import os
+import struct
+
+import google_crc32c
+
+# -- TFRecord framing ----------------------------------------------------------
+
+_MASK_DELTA = 0xA282EAD8
+
+
+def _masked_crc(data):
+    crc = int.from_bytes(google_crc32c.Checksum(data).digest(), "big")
+    return ((((crc >> 15) | (crc << 17)) + _MASK_DELTA) & 0xFFFFFFFF)
+
+
+class TFRecordWriter:
+    def __init__(self, path):
+        self._f = open(path, "wb")
+
+    def write(self, record):
+        header = struct.pack("<Q", len(record))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(record)
+        self._f.write(struct.pack("<I", _masked_crc(record)))
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_records(path, verify_crc=True):
+    """Yield raw record bytes from a TFRecord file."""
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if not header:
+                return
+            if len(header) != 8:
+                raise IOError("truncated TFRecord length header in {}".format(path))
+            (length,) = struct.unpack("<Q", header)
+            (len_crc,) = struct.unpack("<I", f.read(4))
+            if verify_crc and _masked_crc(header) != len_crc:
+                raise IOError("corrupt TFRecord length crc in {}".format(path))
+            data = f.read(length)
+            if len(data) != length:
+                raise IOError("truncated TFRecord payload in {}".format(path))
+            (data_crc,) = struct.unpack("<I", f.read(4))
+            if verify_crc and _masked_crc(data) != data_crc:
+                raise IOError("corrupt TFRecord payload crc in {}".format(path))
+            yield data
+
+
+# -- minimal protobuf wire codec ----------------------------------------------
+
+
+def _varint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf, pos):
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _tag(field, wire_type):
+    return _varint((field << 3) | wire_type)
+
+
+def _len_delimited(field, payload):
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+# -- Example proto -------------------------------------------------------------
+
+
+def encode_feature(values):
+    """One tf.train.Feature: list of bytes → BytesList, ints → Int64List
+    (packed varints), floats → FloatList (packed fixed32)."""
+    if not values:
+        return b""
+    v0 = values[0]
+    if isinstance(v0, (bytes, bytearray, str)):
+        payload = b"".join(
+            _len_delimited(1, v if isinstance(v, bytes) else str(v).encode("utf-8"))
+            for v in values
+        )
+        return _len_delimited(1, payload)  # Feature.bytes_list
+    if isinstance(v0, (bool,)) or isinstance(v0, int):
+        packed = b"".join(_varint(v & 0xFFFFFFFFFFFFFFFF) for v in values)
+        return _len_delimited(3, _len_delimited(1, packed))  # Feature.int64_list
+    if isinstance(v0, float):
+        packed = struct.pack("<{}f".format(len(values)), *values)
+        return _len_delimited(2, _len_delimited(1, packed))  # Feature.float_list
+    raise TypeError("unsupported feature value type {!r}".format(type(v0)))
+
+
+def encode_example(features):
+    """``{name: list-of-values}`` → serialized tf.train.Example bytes."""
+    entries = b""
+    for name in sorted(features):
+        values = features[name]
+        if not isinstance(values, (list, tuple)):
+            values = [values]
+        entry = _len_delimited(1, name.encode("utf-8")) + _len_delimited(
+            2, encode_feature(list(values))
+        )
+        entries += _len_delimited(1, entry)  # Features.feature map entry
+    return _len_delimited(1, entries)  # Example.features
+
+
+def _decode_packed_varints(buf):
+    out, pos = [], 0
+    while pos < len(buf):
+        v, pos = _read_varint(buf, pos)
+        if v >= 1 << 63:  # two's-complement negative int64
+            v -= 1 << 64
+        out.append(v)
+    return out
+
+
+def _decode_feature(buf):
+    """Feature bytes → ('bytes'|'int64'|'float', values)."""
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        assert wt == 2, "unexpected wire type in Feature"
+        length, pos = _read_varint(buf, pos)
+        inner = buf[pos : pos + length]
+        pos += length
+        if field == 1:  # BytesList
+            vals, ipos = [], 0
+            while ipos < len(inner):
+                t, ipos = _read_varint(inner, ipos)
+                assert t >> 3 == 1
+                ln, ipos = _read_varint(inner, ipos)
+                vals.append(bytes(inner[ipos : ipos + ln]))
+                ipos += ln
+            return "bytes", vals
+        if field == 2:  # FloatList
+            vals, ipos = [], 0
+            while ipos < len(inner):
+                t, ipos = _read_varint(inner, ipos)
+                assert t >> 3 == 1
+                if t & 7 == 2:  # packed
+                    ln, ipos = _read_varint(inner, ipos)
+                    vals.extend(
+                        struct.unpack("<{}f".format(ln // 4), inner[ipos : ipos + ln])
+                    )
+                    ipos += ln
+                else:  # unpacked fixed32
+                    vals.append(struct.unpack("<f", inner[ipos : ipos + 4])[0])
+                    ipos += 4
+            return "float", vals
+        if field == 3:  # Int64List
+            vals, ipos = [], 0
+            while ipos < len(inner):
+                t, ipos = _read_varint(inner, ipos)
+                assert t >> 3 == 1
+                if t & 7 == 2:  # packed
+                    ln, ipos = _read_varint(inner, ipos)
+                    vals.extend(_decode_packed_varints(inner[ipos : ipos + ln]))
+                    ipos += ln
+                else:  # unpacked varint
+                    v, ipos = _read_varint(inner, ipos)
+                    if v >= 1 << 63:
+                        v -= 1 << 64
+                    vals.append(v)
+            return "int64", vals
+    return "bytes", []
+
+
+def decode_example(buf):
+    """Serialized Example → ``{name: (kind, values)}``."""
+    out = {}
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        if tag >> 3 != 1 or tag & 7 != 2:
+            raise ValueError("not an Example proto")
+        length, pos = _read_varint(buf, pos)
+        features_buf = buf[pos : pos + length]
+        pos += length
+        fpos = 0
+        while fpos < len(features_buf):
+            ftag, fpos = _read_varint(features_buf, fpos)
+            assert ftag >> 3 == 1 and ftag & 7 == 2, "bad Features map entry"
+            flen, fpos = _read_varint(features_buf, fpos)
+            entry = features_buf[fpos : fpos + flen]
+            fpos += flen
+            epos = 0
+            name, feature = None, ("bytes", [])
+            while epos < len(entry):
+                etag, epos = _read_varint(entry, epos)
+                elen, epos = _read_varint(entry, epos)
+                payload = entry[epos : epos + elen]
+                epos += elen
+                if etag >> 3 == 1:
+                    name = payload.decode("utf-8")
+                elif etag >> 3 == 2:
+                    feature = _decode_feature(payload)
+            if name is not None:
+                out[name] = feature
+    return out
+
+
+# -- directory-level helpers ---------------------------------------------------
+
+
+def write_shard(path, examples):
+    """Write a list of feature-dicts as one TFRecord shard file."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    count = 0
+    with TFRecordWriter(path) as w:
+        for features in examples:
+            w.write(encode_example(features))
+            count += 1
+    return count
+
+
+def list_shards(directory):
+    """TFRecord shard files under a directory (reference part-r-* layout)."""
+    out = []
+    for name in sorted(os.listdir(directory)):
+        if name.startswith(("part-", "shard-")) and not name.endswith((".crc", ".tmp")):
+            out.append(os.path.join(directory, name))
+    return out
+
+
+def read_examples(path):
+    for rec in read_records(path):
+        yield decode_example(rec)

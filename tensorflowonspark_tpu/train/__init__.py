@@ -15,6 +15,9 @@ _EXPORTS = {
     "steps_per_worker": "strategy",
     "checkpoint": None,
     "strategy": None,
+    "export": None,
+    "export_model": "export",
+    "load_model": "export",
 }
 
 

@@ -1,0 +1,57 @@
+"""Model export bundles — the SavedModel equivalent.
+
+The reference's pipeline could reload *any* trained model because a TF
+SavedModel carries its own graph (/root/reference/tensorflowonspark/
+pipeline.py:585-644 introspects signatures at load time). A jax checkpoint
+carries only arrays, so the bundle format here is: an orbax checkpoint for
+``{params, model_state}`` plus a cloudpickled **predict-fn builder** — code +
+weights, restorable on any host (including CPU-only inference executors)
+without knowing the architecture in advance.
+"""
+
+import logging
+import os
+
+import cloudpickle
+
+logger = logging.getLogger(__name__)
+
+_BUILDER_FILE = "predict_builder.pkl"
+_CKPT_DIR = "checkpoint"
+
+
+def export_model(export_dir, predict_builder, params, model_state=None):
+    """Write a self-contained inference bundle.
+
+    ``predict_builder`` is a picklable zero-arg callable returning
+    ``predict_fn(params, model_state, batch_arrays) -> outputs`` (a dict of
+    named arrays or a single array). It is invoked lazily at load time, so jax
+    is only imported in the serving process.
+    """
+    from tensorflowonspark_tpu.train import checkpoint
+
+    export_dir = os.path.abspath(os.path.expanduser(export_dir))
+    os.makedirs(export_dir, exist_ok=True)
+    state = {"params": params}
+    if model_state is not None:
+        state["model_state"] = model_state
+    checkpoint.save_checkpoint(os.path.join(export_dir, _CKPT_DIR), state)
+    with open(os.path.join(export_dir, _BUILDER_FILE), "wb") as f:
+        cloudpickle.dump(predict_builder, f)
+    logger.info("exported model bundle to %s", export_dir)
+    return export_dir
+
+
+def load_model(export_dir):
+    """Load a bundle: returns ``(predict_fn, params, model_state)``."""
+    from tensorflowonspark_tpu.train import checkpoint
+
+    export_dir = os.path.abspath(os.path.expanduser(export_dir))
+    with open(os.path.join(export_dir, _BUILDER_FILE), "rb") as f:
+        predict_builder = cloudpickle.load(f)
+    state = checkpoint.restore_checkpoint(os.path.join(export_dir, _CKPT_DIR))
+    return predict_builder(), state["params"], state.get("model_state") or {}
+
+
+def is_model_bundle(path):
+    return os.path.isfile(os.path.join(path, _BUILDER_FILE))
