@@ -1,0 +1,70 @@
+"""Parallel single-node inference from an exported bundle via TFParallel.
+
+Parity with /root/reference/examples/mnist/keras/mnist_inference.py
+(TFParallel + saved_model + per-worker ``ds.shard``, :42).
+
+Usage:
+    python examples/mnist/mnist_inference.py --export_dir /tmp/mnist_bundle \
+        --output /tmp/mnist_preds --cluster_size 2
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def inference_fun(args, ctx):
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.train import export
+
+    predict_fn, params, model_state = export.load_model(args.export_dir)
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__))))
+    from mnist_data_setup import synthetic_mnist
+
+    images, labels = synthetic_mnist(args.num_examples, seed=99)
+    # each instance handles its shard (reference ds.shard(num_workers, i))
+    idx = np.arange(ctx.executor_id, len(labels), ctx.num_workers)
+
+    os.makedirs(args.output, exist_ok=True)
+    correct = total = 0
+    with open(os.path.join(args.output, "part-{:05d}".format(ctx.executor_id)), "w") as f:
+        for start in range(0, len(idx), args.batch_size):
+            chunk = idx[start : start + args.batch_size]
+            out = predict_fn(params, model_state, {"image": images[chunk].reshape(len(chunk), -1)})
+            preds = np.asarray(out["prediction"] if isinstance(out, dict) else out)[: len(chunk)]
+            for i, p in zip(chunk, preds):
+                f.write("{} {}\n".format(labels[i], int(p)))
+                correct += int(labels[i] == p)
+                total += 1
+    print("instance {}: {}/{} correct".format(ctx.executor_id, correct, total))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=256)
+    parser.add_argument("--cluster_size", type=int, default=2)
+    parser.add_argument("--export_dir", required=True)
+    parser.add_argument("--num_examples", type=int, default=2048)
+    parser.add_argument("--output", required=True)
+    parser.add_argument("--platform", default=None)
+    args = parser.parse_args(argv)
+
+    from tensorflowonspark_tpu import TFParallel
+    from tensorflowonspark_tpu.backends.local import LocalSparkContext
+
+    sc = LocalSparkContext(num_executors=args.cluster_size)
+    env = {"JAX_PLATFORMS": args.platform} if args.platform else None
+    try:
+        TFParallel.run(sc, inference_fun, args, args.cluster_size, env=env)
+        print("inference shards in", args.output)
+    finally:
+        sc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""MNIST through the Spark-ML pipeline API: TFEstimator.fit → TFModel.transform.
+
+Parity with /root/reference/examples/mnist/keras/mnist_pipeline.py (TFEstimator
++ TFModel + dfutil TFRecords, :107-148).
+
+Usage:
+    python examples/mnist/mnist_pipeline.py --export_dir /tmp/mnist_bundle
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def train_fun(args, ctx):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import parallel
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.train import SyncDataParallel, export
+
+    strategy = SyncDataParallel(parallel.local_mesh({"dp": -1}))
+    model = mnist.create_model("mlp")
+    optimizer = optax.adam(1e-3)
+    state = strategy.create_state(mnist.make_init_fn(model), optimizer, jax.random.PRNGKey(0))
+    step = strategy.compile_train_step(mnist.make_loss_fn(model), optimizer, has_aux=True)
+
+    feed = ctx.get_data_feed(train_mode=True)
+    while not feed.should_stop():
+        batch = feed.next_batch(args.batch_size)
+        if not batch:
+            break
+        images = np.asarray([b[0] for b in batch], np.float32).reshape(-1, 28, 28)
+        labels = np.asarray([b[1] for b in batch])
+        state, metrics = step(state, strategy.shard_batch({"image": images, "label": labels}))
+
+    if ctx.job_name in ("chief", "master"):
+        params = jax.device_get(state.params)
+
+        def predict_builder():
+            import jax as _jax
+            import numpy as _np
+
+            from tensorflowonspark_tpu.models import mnist as _mnist
+
+            _model = _mnist.create_model("mlp")
+            _predict = _jax.jit(_mnist.make_predict_fn(_model))
+
+            def predict(p, ms, arrays):
+                images = _np.asarray(arrays["image"], _np.float32).reshape(-1, 28, 28)
+                return {"prediction": _predict(p, {"image": images})}
+
+            return predict
+
+        export.export_model(args.export_dir, predict_builder, params)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=64)
+    parser.add_argument("--cluster_size", type=int, default=2)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--export_dir", required=True)
+    parser.add_argument("--num_examples", type=int, default=4096)
+    parser.add_argument("--platform", default=None)
+    args = parser.parse_args(argv)
+
+    from tensorflowonspark_tpu import pipeline
+    from tensorflowonspark_tpu.backends.local import LocalSparkContext
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from mnist_data_setup import synthetic_mnist
+
+    images, labels = synthetic_mnist(args.num_examples)
+    rows = [(images[i].ravel().tolist(), int(labels[i])) for i in range(len(labels))]
+
+    sc = LocalSparkContext(num_executors=args.cluster_size)
+    env = {"JAX_PLATFORMS": args.platform} if args.platform else None
+    try:
+        df = sc.createDataFrame(rows, ["image", "label"], 8)
+        est = (
+            pipeline.TFEstimator(train_fun, vars(args), env=env)
+            .setInputMapping({"image": "image", "label": "label"})
+            .setBatchSize(args.batch_size)
+            .setEpochs(args.epochs)
+            .setClusterSize(args.cluster_size)
+            .setExportDir(args.export_dir)
+            .setGraceSecs(5)
+        )
+        model = est.fit(df)
+
+        model.setInputMapping({"image": "image"}).setOutputMapping(
+            {"prediction": "prediction"}
+        ).setExportDir(args.export_dir)
+        test_df = sc.createDataFrame([(r[0],) for r in rows[:256]], ["image"], 4)
+        preds = [r[0] for r in model.transform(test_df).collect()]
+        acc = sum(int(p == labels[i]) for i, p in enumerate(preds)) / len(preds)
+        print("pipeline inference accuracy on {} rows: {:.3f}".format(len(preds), acc))
+    finally:
+        sc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""MNIST training with InputMode.SPARK — RDD partitions stream into the
+cluster's feed queues and each node trains a data-parallel model over its
+local chips.
+
+Parity with /root/reference/examples/mnist/keras/mnist_spark.py: same flow
+(DataFeed → batches → train → chief exports), with the reference's
+90%-of-steps safeguard for uneven partitions surfaced via
+``steps_per_worker`` (reference buried it at mnist_spark.py:58-64).
+
+Usage:
+    python examples/mnist/mnist_spark.py --cluster_size 2 --epochs 3 \
+        --model_dir /tmp/mnist_model --export_dir /tmp/mnist_export
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main_fun(args, ctx):
+    """Runs inside the jax child process on every cluster node."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import parallel
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.train import SyncDataParallel, checkpoint, export, steps_per_worker
+
+    ctx.initialize_distributed()  # no-op single-host
+    mesh = parallel.local_mesh({"dp": -1}) if ctx.num_processes == 1 else ctx.mesh({"dp": -1})
+    strategy = SyncDataParallel(mesh)
+    model = mnist.create_model("mlp")
+    optimizer = optax.adam(args.learning_rate)
+    state = strategy.create_state(mnist.make_init_fn(model), optimizer, jax.random.PRNGKey(0))
+    step = strategy.compile_train_step(mnist.make_loss_fn(model), optimizer, has_aux=True)
+
+    max_steps = steps_per_worker(args.num_examples * args.epochs, args.batch_size, ctx.num_workers)
+    feed = ctx.get_data_feed(train_mode=True)
+    steps = 0
+    while not feed.should_stop() and steps < max_steps:
+        batch = feed.next_batch(args.batch_size)
+        if not batch:
+            break
+        images = np.asarray([b[0] for b in batch], np.float32).reshape(-1, 28, 28)
+        labels = np.asarray([b[1] for b in batch])
+        state, metrics = step(state, strategy.shard_batch({"image": images, "label": labels}))
+        steps += 1
+        if steps % 100 == 0:
+            print("step {} loss {:.4f} acc {:.3f}".format(
+                steps, float(metrics["loss"]), float(metrics["accuracy"])))
+        if args.model_dir and steps % args.checkpoint_steps == 0 and ctx.process_id == 0:
+            checkpoint.save_checkpoint(
+                os.path.join(args.model_dir, "ckpt_{}".format(steps)), jax.device_get(state))
+    if not feed.should_stop():
+        feed.terminate()
+
+    if args.export_dir and ctx.job_name in ("chief", "master"):
+        params = jax.device_get(state.params)
+
+        def predict_builder():
+            import jax as _jax
+
+            from tensorflowonspark_tpu.models import mnist as _mnist
+
+            _model = _mnist.create_model("mlp")
+            _predict = _mnist.make_predict_fn(_model)
+            return _jax.jit(lambda p, ms, a: {"prediction": _predict(p, a)})
+
+        export.export_model(args.export_dir, predict_builder, params)
+        print("exported model bundle to", args.export_dir)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=64)
+    parser.add_argument("--checkpoint_steps", type=int, default=100)
+    parser.add_argument("--cluster_size", type=int, default=2)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--learning_rate", type=float, default=1e-3)
+    parser.add_argument("--model_dir", default=None)
+    parser.add_argument("--export_dir", default=None)
+    parser.add_argument("--num_examples", type=int, default=4096)
+    parser.add_argument("--num_partitions", type=int, default=8)
+    parser.add_argument("--tensorboard", action="store_true")
+    parser.add_argument("--platform", default=None, help="force JAX_PLATFORMS in nodes (e.g. cpu)")
+    args = parser.parse_args(argv)
+
+    from tensorflowonspark_tpu import TFCluster
+    from tensorflowonspark_tpu.backends.local import LocalSparkContext
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__)))
+    from mnist_data_setup import synthetic_mnist
+
+    images, labels = synthetic_mnist(args.num_examples)
+    data = [(images[i].ravel().tolist(), int(labels[i])) for i in range(len(labels))]
+
+    sc = LocalSparkContext(num_executors=args.cluster_size)
+    env = {"JAX_PLATFORMS": args.platform} if args.platform else None
+    try:
+        cluster = TFCluster.run(
+            sc, main_fun, args, args.cluster_size,
+            input_mode=TFCluster.InputMode.SPARK, master_node="chief",
+            tensorboard=args.tensorboard, env=env,
+        )
+        cluster.train(sc.parallelize(data, args.num_partitions), num_epochs=args.epochs)
+        cluster.shutdown(grace_secs=5)
+        print("training complete")
+    finally:
+        sc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""MNIST training with InputMode.TENSORFLOW — each node reads its own shard
+of TFRecords directly from the filesystem (the perf path: no feed queues).
+
+Parity with /root/reference/examples/mnist/keras/mnist_tf_ds.py (TFRecords
+read directly per worker with ``ds.shard(num_workers, index)``).
+
+Usage:
+    python examples/mnist/mnist_data_setup.py --output /tmp/mnist_tfr
+    python examples/mnist/mnist_tf.py --data_dir /tmp/mnist_tfr --cluster_size 2
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main_fun(args, ctx):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import tfrecord, parallel
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.train import SyncDataParallel
+
+    ctx.initialize_distributed()
+    mesh = parallel.local_mesh({"dp": -1}) if ctx.num_processes == 1 else ctx.mesh({"dp": -1})
+    strategy = SyncDataParallel(mesh)
+    model = mnist.create_model("mlp")
+    optimizer = optax.adam(args.learning_rate)
+    state = strategy.create_state(mnist.make_init_fn(model), optimizer, jax.random.PRNGKey(0))
+    step = strategy.compile_train_step(mnist.make_loss_fn(model), optimizer, has_aux=True)
+
+    # this worker's shard of the files (reference: ds.shard(num_workers, i))
+    shards = tfrecord.list_shards(args.data_dir)
+    my_rank = ctx.executor_id
+    my_files = [s for i, s in enumerate(shards) if i % ctx.num_workers == my_rank % ctx.num_workers]
+
+    def batches():
+        images, labels = [], []
+        for _ in range(args.epochs):
+            for path in my_files:
+                for ex in tfrecord.read_examples(path):
+                    images.append(np.asarray(ex["image"][1], np.float32).reshape(28, 28))
+                    labels.append(int(ex["label"][1][0]))
+                    if len(images) == args.batch_size:
+                        yield {"image": np.stack(images), "label": np.asarray(labels)}
+                        images, labels = [], []
+
+    metrics = {}
+    for i, batch in enumerate(batches()):
+        state, metrics = step(state, strategy.shard_batch(batch))
+        if (i + 1) % 100 == 0:
+            print("step {} loss {:.4f} acc {:.3f}".format(
+                i + 1, float(metrics["loss"]), float(metrics["accuracy"])))
+    if metrics:
+        print("final: loss {:.4f} acc {:.3f}".format(
+            float(metrics["loss"]), float(metrics["accuracy"])))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data_dir", required=True)
+    parser.add_argument("--batch_size", type=int, default=64)
+    parser.add_argument("--cluster_size", type=int, default=2)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--learning_rate", type=float, default=1e-3)
+    parser.add_argument("--platform", default=None)
+    args = parser.parse_args(argv)
+
+    from tensorflowonspark_tpu import TFCluster
+    from tensorflowonspark_tpu.backends.local import LocalSparkContext
+
+    sc = LocalSparkContext(num_executors=args.cluster_size)
+    env = {"JAX_PLATFORMS": args.platform} if args.platform else None
+    try:
+        cluster = TFCluster.run(
+            sc, main_fun, args, args.cluster_size,
+            input_mode=TFCluster.InputMode.TENSORFLOW, master_node="chief", env=env,
+        )
+        cluster.shutdown()
+        print("training complete")
+    finally:
+        sc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""ResNet training on a cluster — the performance workload.
+
+Parity with /root/reference/examples/resnet/resnet_cifar_spark.py +
+resnet_imagenet_main.py: ``--dataset cifar`` trains ResNet-56 (batch 128,
+piecewise LR like resnet_cifar_dist.py:34-36), ``--dataset imagenet`` trains
+ResNet-50 v1.5 (base LR 0.1·bs/256 with warmup like
+resnet_imagenet_main.py:37-71). ``--use_synthetic_data`` mirrors the
+reference's synthetic input path (common.py:315) and is the default here
+(no dataset downloads in this environment); bf16 compute replaces the
+reference's fp16+LossScaleOptimizer.
+
+Usage:
+    python examples/resnet/resnet_spark.py --dataset cifar --train_steps 100 \
+        --use_synthetic_data
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def lr_schedule(args):
+    """Reference schedules: piecewise for CIFAR, warmup+scaled for ImageNet."""
+    import optax
+
+    if args.dataset == "cifar":
+        # (0.1, 91ep) (0.01, 136ep) (0.001, 182ep) — in steps
+        spe = max(args.steps_per_epoch, 1)
+        return optax.piecewise_constant_schedule(
+            0.1, {91 * spe: 0.1, 136 * spe: 0.1}
+        )
+    base = 0.1 * args.batch_size / 256.0
+    warmup = 5 * max(args.steps_per_epoch, 1)
+    return optax.linear_schedule(0.0, base, warmup)
+
+
+def main_fun(args, ctx):
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import parallel
+    from tensorflowonspark_tpu.models import resnet
+    from tensorflowonspark_tpu.train import SyncDataParallel
+
+    ctx.initialize_distributed()
+    mesh = parallel.local_mesh({"dp": -1}) if ctx.num_processes == 1 else ctx.mesh({"dp": -1})
+    strategy = SyncDataParallel(mesh)
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    if args.dataset == "cifar":
+        model, image_size, classes = resnet.resnet56(dtype=dtype), 32, 10
+    else:
+        model, image_size, classes = resnet.resnet50(dtype=dtype), 224, 1000
+    optimizer = optax.sgd(lr_schedule(args), momentum=0.9)
+    state = strategy.create_state(
+        resnet.make_init_fn(model, image_size=image_size), optimizer, jax.random.PRNGKey(0)
+    )
+    step = strategy.compile_train_step(
+        resnet.make_loss_fn(model, weight_decay=1e-4), optimizer, mutable=True
+    )
+
+    rng = np.random.default_rng(ctx.executor_id)
+    batch = strategy.shard_batch(
+        {
+            "image": rng.standard_normal((args.batch_size, image_size, image_size, 3)).astype(np.float32),
+            "label": rng.integers(0, classes, args.batch_size),
+        }
+    )
+    t0, metrics = time.perf_counter(), {}
+    for i in range(args.train_steps):
+        if not args.use_synthetic_data:
+            raise NotImplementedError("real-data input pipeline: use TFRecords via mnist_tf.py pattern")
+        state, metrics = step(state, batch)
+        if (i + 1) % args.log_steps == 0:
+            jax.block_until_ready(metrics["loss"])
+            dt = time.perf_counter() - t0
+            # avg_exp_per_second analogue (reference common.py:241-244)
+            print("step {}: loss {:.3f} {:.1f} img/s".format(
+                i + 1, float(metrics["loss"]), args.batch_size * args.log_steps / dt))
+            t0 = time.perf_counter()
+    if metrics:
+        jax.block_until_ready(metrics["loss"])
+        print("final loss {:.3f}".format(float(metrics["loss"])))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=128)
+    parser.add_argument("--cluster_size", type=int, default=1)
+    parser.add_argument("--dataset", choices=["cifar", "imagenet"], default="cifar")
+    parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16")
+    parser.add_argument("--log_steps", type=int, default=20)
+    parser.add_argument("--steps_per_epoch", type=int, default=390)
+    parser.add_argument("--train_steps", type=int, default=100)
+    parser.add_argument("--use_synthetic_data", action="store_true", default=True)
+    parser.add_argument("--platform", default=None)
+    args = parser.parse_args(argv)
+
+    from tensorflowonspark_tpu import TFCluster
+    from tensorflowonspark_tpu.backends.local import LocalSparkContext
+
+    sc = LocalSparkContext(num_executors=args.cluster_size)
+    env = {"JAX_PLATFORMS": args.platform} if args.platform else None
+    try:
+        cluster = TFCluster.run(
+            sc, main_fun, args, args.cluster_size,
+            input_mode=TFCluster.InputMode.TENSORFLOW, master_node="chief", env=env,
+        )
+        cluster.shutdown()
+        print("resnet training complete")
+    finally:
+        sc.stop()
+
+
+if __name__ == "__main__":
+    main()

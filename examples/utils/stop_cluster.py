@@ -1,0 +1,29 @@
+"""Request early stop of a live cluster from outside the driver.
+
+Parity with /root/reference/examples/utils/stop_streaming.py (drives
+``reservation.Client.request_stop`` against a running cluster, :12-18).
+
+Usage:
+    python examples/utils/stop_cluster.py <host> <port>
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 3)[0])
+
+from tensorflowonspark_tpu import reservation
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 2:
+        print(__doc__)
+        raise SystemExit(2)
+    host, port = argv[0], int(argv[1])
+    client = reservation.Client((host, port))
+    client.request_stop()
+    print("requested stop of cluster at {}:{}".format(host, port))
+
+
+if __name__ == "__main__":
+    main()

@@ -50,9 +50,15 @@ class _ParallelTask:
         chip_ids = None
         n_chips = tpu_info.detect_local_chips()
         if n_chips and self.env.get("JAX_PLATFORMS") != "cpu":
-            per = max(1, n_chips // self.num_executors)
-            start = (executor_id * per) % n_chips
-            chip_ids = list(range(start, min(start + per, n_chips)))
+            local_rank, num_local = self._local_placement(executor_id)
+            if num_local > n_chips:
+                raise RuntimeError(
+                    "{} TFParallel instances on this host but only {} chips — "
+                    "reduce num_executors or instances per host".format(num_local, n_chips)
+                )
+            per = n_chips // num_local
+            start = local_rank * per
+            chip_ids = list(range(start, start + per))
 
         def _entry():
             try:
@@ -75,6 +81,27 @@ class _ParallelTask:
                 "TFParallel instance {} failed (exit {})".format(executor_id, child.exitcode)
             )
         return [executor_id]
+
+    def _local_placement(self, executor_id):
+        """(host-local rank, instances on this host). Real Spark barrier mode
+        exposes co-located tasks via BarrierTaskContext (the reference's
+        placement source, TFParallel.py:42-45); the local backend runs every
+        instance on one host, so there the global id IS the local rank."""
+        try:
+            from pyspark import BarrierTaskContext
+
+            ctx = BarrierTaskContext.get()
+            infos = ctx.getTaskInfos()
+            import socket
+
+            me = socket.gethostname()
+            local = [
+                i for i, t in enumerate(infos)
+                if t.address.split(":")[0] in (me, "localhost", "127.0.0.1")
+            ]
+            return local.index(ctx.partitionId()), max(len(local), 1)
+        except Exception:
+            return executor_id, self.num_executors
 
 
 def run(sc, map_fn, tf_args, num_executors, env=None):

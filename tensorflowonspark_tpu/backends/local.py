@@ -125,9 +125,14 @@ class LocalRDD:
         return rdd
 
     def mapPartitionsWithIndex(self, fn):
-        """``fn(partition_index, iterator)`` like pyspark's."""
-        fn._wants_index = True
-        return self.mapPartitions(fn)
+        """``fn(partition_index, iterator)`` like pyspark's. The flag lives on
+        a fresh wrapper, never on the caller's function object."""
+
+        def _indexed(pidx, it, _fn=fn):
+            return _fn(pidx, it)
+
+        _indexed._wants_index = True
+        return self.mapPartitions(_indexed)
 
     def map(self, fn):
         def _mapper(it, _fn=fn):

@@ -30,6 +30,13 @@ def isLoadedDF(df):
     return loadedDF.get(id(df)) is df
 
 
+def loadedDFSource(df):
+    """Input directory a loaded DataFrame came from, or None — the provenance
+    lookup the reference's pipeline used to reuse already-converted TFRecords
+    (reference pipeline.py tfrecord_dir reuse)."""
+    return _loaded_dirs.get(id(df)) if isLoadedDF(df) else None
+
+
 def toTFExample(row, columns, binary_features=()):
     """One row (sequence) → feature dict ready for Example encoding.
 
@@ -127,12 +134,18 @@ def loadTFRecords(sc, input_dir, binary_features=(), columns=None):
     bin_feats = tuple(binary_features)
 
     if columns is None:
-        # union the schema over the whole first shard: a None in one row makes
-        # toTFExample omit that column from that record, so a single record is
-        # not a reliable schema witness
+        # union the schema over the whole first shard plus the first record of
+        # every other shard: a None value makes toTFExample omit that column
+        # from a record, so no single record (or single shard) is a reliable
+        # schema witness
         names = set()
         for example in tfrecord.read_examples(shards[0]):
             names.update(infer_schema(example, bin_feats))
+        for path in shards[1:]:
+            try:
+                names.update(infer_schema(next(tfrecord.read_examples(path)), bin_feats))
+            except StopIteration:
+                pass
         columns = sorted(names)
 
     def _read_shard(it):

@@ -1,0 +1,65 @@
+"""Example-level smoke tests (reference ran its resnet examples with
+synthetic data and train_steps=1, resnet_cifar_test.py:36-40; same spirit)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+ENV = dict(
+    os.environ,
+    JAX_PLATFORMS="cpu",
+    XLA_FLAGS="--xla_force_host_platform_device_count=1",
+)
+
+
+def _run(script, *args, timeout=420):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), *args],
+        capture_output=True, text=True, timeout=timeout, env=ENV,
+        cwd=os.path.join(EXAMPLES, ".."),
+    )
+    assert proc.returncode == 0, "{} failed:\n{}\n{}".format(script, proc.stdout[-2000:], proc.stderr[-2000:])
+    return proc.stdout
+
+
+def test_mnist_data_setup_and_tf_mode(tmp_path):
+    data = str(tmp_path / "tfr")
+    _run("mnist/mnist_data_setup.py", "--output", data, "--num_examples", "512")
+    out = _run(
+        "mnist/mnist_tf.py", "--data_dir", data, "--cluster_size", "1",
+        "--epochs", "1", "--batch_size", "64", "--platform", "cpu",
+    )
+    assert "training complete" in out
+
+
+def test_mnist_spark_mode(tmp_path):
+    export_dir = str(tmp_path / "bundle")
+    out = _run(
+        "mnist/mnist_spark.py", "--cluster_size", "1", "--epochs", "1",
+        "--num_examples", "512", "--batch_size", "64",
+        "--export_dir", export_dir, "--platform", "cpu",
+    )
+    assert "training complete" in out
+    assert os.path.isdir(export_dir)
+
+
+def test_segmentation_spark(tmp_path):
+    out = _run(
+        "segmentation/segmentation_spark.py", "--cluster_size", "1",
+        "--train_steps", "4", "--image_size", "32", "--depth", "2",
+        "--base_filters", "8", "--batch_size", "4", "--platform", "cpu",
+    )
+    assert "segmentation training complete" in out
+
+
+@pytest.mark.slow
+def test_resnet_cifar_synthetic():
+    out = _run(
+        "resnet/resnet_spark.py", "--dataset", "cifar", "--train_steps", "2",
+        "--batch_size", "8", "--log_steps", "1", "--dtype", "fp32",
+        "--platform", "cpu",
+    )
+    assert "resnet training complete" in out
