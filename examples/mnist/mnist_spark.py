@@ -51,7 +51,12 @@ def main_fun(args, ctx):
         if steps % 100 == 0:
             print("step {} loss {:.4f} acc {:.3f}".format(
                 steps, float(metrics["loss"]), float(metrics["accuracy"])))
-        if args.model_dir and steps % args.checkpoint_steps == 0 and ctx.process_id == 0:
+        # in a multi-process world orbax saves are collective — EVERY process
+        # must call save (gating on process 0 hangs the barrier); with
+        # independent single-process nodes only the chief saves, or the
+        # workers would race on the same checkpoint directory
+        is_saver = ctx.distributed or ctx.job_name in ("chief", "master") or ctx.num_workers <= 1
+        if args.model_dir and steps % args.checkpoint_steps == 0 and is_saver:
             checkpoint.save_checkpoint(
                 os.path.join(args.model_dir, "ckpt_{}".format(steps)), jax.device_get(state))
     if not feed.should_stop():

@@ -87,6 +87,27 @@ class _ChannelManager(BaseManager):
 _ChannelManager.register("get_channel")
 
 
+#: the one _Channel instance inside a channel-server process
+_server_channel = None
+
+
+def _init_server_channel(qnames):
+    global _server_channel
+    _server_channel = _Channel(qnames)
+
+
+def _get_server_channel():
+    return _server_channel
+
+
+class _HostManager(BaseManager):
+    """Server-side manager class (module-level so the spawn start method can
+    pickle its ``_run_server`` target)."""
+
+
+_HostManager.register("get_channel", callable=_get_server_channel)
+
+
 class QueueView:
     """A named-queue facade bound to one queue of an :class:`ExecutorIPC`.
 
@@ -170,17 +191,13 @@ def start(authkey, queues=WORKER_QUEUES, mode="local"):
     """
     if isinstance(authkey, str):
         authkey = authkey.encode("utf-8")
-    # fork context: the channel object must be inherited by the server process
-    ctx = multiprocessing.get_context("fork")
-    channel = _Channel(tuple(queues))
-
-    class _Host(BaseManager):
-        pass
-
-    _Host.register("get_channel", callable=lambda: channel)
+    # spawn context (fork from a threaded caller deadlocks — see
+    # util.spawn_process); the channel object is created *inside* the server
+    # process by the initializer, every get_channel proxy resolves to it
+    ctx = multiprocessing.get_context("spawn")
     address = ("", 0) if mode == "remote" else None
-    host = _Host(address=address, authkey=authkey, ctx=ctx)
-    host.start()
+    host = _HostManager(address=address, authkey=authkey, ctx=ctx)
+    host.start(initializer=_init_server_channel, initargs=(tuple(queues),))
     # child processes of this process need the same authkey for digest auth
     multiprocessing.current_process().authkey = authkey
     addr = host.address

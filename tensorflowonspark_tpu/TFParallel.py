@@ -6,7 +6,7 @@ Capability-parity with /root/reference/tensorflowonspark/TFParallel.py
 TFParallel.py:17-64): each executor gets a synthetic
 :class:`~tensorflowonspark_tpu.TFSparkNode.TFNodeContext` (executor id from
 the task's partition index, ``num_workers`` = parallelism, no manager/feed
-plane) and runs the user function in a forked jax child so libtpu's
+plane) and runs the user function in a spawned jax child so libtpu's
 process-owns-chips rule holds and chips free up when the task ends.
 """
 
@@ -14,11 +14,9 @@ import logging
 import os
 import traceback
 
-from tensorflowonspark_tpu import TFSparkNode, tpu_info
+from tensorflowonspark_tpu import TFSparkNode, tpu_info, util
 
 logger = logging.getLogger(__name__)
-
-_mp = __import__("multiprocessing").get_context("fork")
 
 
 class _ParallelTask:
@@ -73,7 +71,7 @@ class _ParallelTask:
                 logger.error("TFParallel fn failed:\n%s", traceback.format_exc())
                 raise SystemExit(1)
 
-        child = _mp.Process(target=_entry, name="jax-parallel-{}".format(executor_id))
+        child = util.spawn_process(_entry, name="jax-parallel-{}".format(executor_id))
         child.start()
         child.join()
         if child.exitcode != 0:

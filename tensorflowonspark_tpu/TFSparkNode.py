@@ -11,12 +11,12 @@ for the TPU process model. Per executor, the launch task:
 4. derives the jax.distributed world — coordinator address, process count,
    process id — from the assembled cluster info (the ClusterSpec/TF_CONFIG
    analogue, reference TFSparkNode.py:277-299),
-5. forks the **jax child process** that owns this host's TPU chips and runs the
-   user's ``main_fun(args, ctx)``; the executor process itself never imports
-   jax, so it stays light and reusable across Spark tasks (the reference's
-   bg-process dispatch, TFSparkNode.py:339-395, generalized: on TPU *every*
-   role runs in a child so libtpu's process-owns-chips rule is respected and
-   chips are freed when the child exits).
+5. spawns the **jax child process** that owns this host's TPU chips and runs
+   the user's ``main_fun(args, ctx)``; the executor process itself never
+   imports jax, so it stays light and reusable across Spark tasks (the
+   reference's bg-process dispatch, TFSparkNode.py:339-395, generalized: on
+   TPU *every* role runs in a child so libtpu's process-owns-chips rule is
+   respected and chips are freed when the child exits).
 
 Feeding/inference/shutdown closures are picklable task objects (Spark and the
 local backend both ship them to executors by serialization).
@@ -31,8 +31,6 @@ from tensorflowonspark_tpu import TFManager, TFNode, reservation, tpu_info, util
 from tensorflowonspark_tpu.marker import EndPartition
 
 logger = logging.getLogger(__name__)
-
-_mp = __import__("multiprocessing").get_context("fork")
 
 #: Executor-process-global registry of live IPC channels, keyed by executor id.
 #: Keeps the manager server process alive after the launch task returns (its
@@ -314,9 +312,15 @@ class _NodeLaunchTask:
             job_name, task_index, executor_id, coord, num_procs, proc_id,
         )
 
-        child = _mp.Process(
-            target=_child_entry,
-            args=(self.fn, self.tf_args, ctx, meta, (mgr.address, authkey)),
+        # spawned, not forked: the executor process carries queue-feeder
+        # threads by now, and the child gets a pristine interpreter so the
+        # env vars _child_entry sets land before jax is first imported
+        import functools
+
+        child = util.spawn_process(
+            functools.partial(
+                _child_entry, self.fn, self.tf_args, ctx, meta, (mgr.address, authkey)
+            ),
             name="jax-node-{}-{}".format(job_name, task_index),
         )
         child.start()

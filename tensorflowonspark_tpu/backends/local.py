@@ -33,7 +33,12 @@ import cloudpickle
 
 logger = logging.getLogger(__name__)
 
-_mp = __import__("multiprocessing").get_context("fork")
+# Spawned (never forked): a LocalSparkContext is routinely created from a
+# threaded parent (pytest with a prior context's collector thread, jax's
+# thread pools), and forking a threaded process deadlocks — the documented
+# full-suite hang. Executor children are spawn-clean; the jax child each
+# node launch starts is itself spawned (util.spawn_process).
+_mp = __import__("multiprocessing").get_context("spawn")
 
 #: module-global registry, inside each executor process, of background
 #: child processes started by node-launch tasks (reaped at executor stop)

@@ -75,11 +75,13 @@ def make_init_fn(model, sample_shape=(1, 28, 28)):
 
 
 def make_loss_fn(model, dropout_seed=0):
-    """``loss_fn(params, batch)`` for SyncDataParallel; batch keys
-    ``image`` (N,28,28[,1]) float and ``label`` (N,) int."""
+    """``loss_fn(params, batch, step)`` for SyncDataParallel; batch keys
+    ``image`` (N,28,28[,1]) float and ``label`` (N,) int. The ``step``
+    keyword is filled in by ``compile_train_step`` with ``state.step`` so the
+    dropout mask changes every training step."""
 
-    def loss_fn(params, batch):
-        rng = jax.random.fold_in(jax.random.PRNGKey(dropout_seed), batch.get("step", 0))
+    def loss_fn(params, batch, step=0):
+        rng = jax.random.fold_in(jax.random.PRNGKey(dropout_seed), step)
         logits = model.apply(
             {"params": params}, batch["image"], train=True, rngs={"dropout": rng}
         )

@@ -118,6 +118,23 @@ def visibility_env(chip_ids=None, platform=None):
         env["JAX_PLATFORMS"] = platform
     if chip_ids is not None:
         env["TPU_VISIBLE_CHIPS"] = ",".join(str(c) for c in chip_ids)
-        env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = "1,1,1"
+        env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = _chip_grid_bounds(len(chip_ids))
         env["TPU_PROCESS_BOUNDS"] = "1,1,1"
     return env
+
+
+def _chip_grid_bounds(n):
+    """x,y,z bounds covering ``n`` chips — the per-process bounds must match
+    the visible-chip count or libtpu rejects/ignores the extra chips, and
+    must fit inside the host's chip grid (x is the narrow dimension: v5e-8 /
+    v6e-8 hosts are a 2x4 grid, so 8 chips is '2,4,1', never '4,2,1')."""
+    host = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS")
+    if host:
+        try:
+            hx, hy, hz = (int(v) for v in host.split(","))
+            if hx * hy * hz == n:  # all chips: mirror the host grid exactly
+                return host
+        except ValueError:
+            pass
+    grids = {1: "1,1,1", 2: "1,2,1", 4: "2,2,1", 8: "2,4,1", 16: "4,4,1"}
+    return grids.get(n, "1,{},1".format(n))

@@ -32,15 +32,17 @@ def _to_saveable(state):
     from tensorflowonspark_tpu.train.strategy import TrainState
 
     if isinstance(state, TrainState):
-        out = {
+        # model_state is ALWAYS present (empty dict included) so the saved and
+        # target tree structures agree regardless of whether the model carries
+        # batch_stats — restoring a stats-bearing checkpoint into a fresh
+        # TrainState must not silently drop the stats
+        return {
             _STATE_SENTINEL: 1,
             "step": state.step,
             "params": state.params,
             "opt_state": state.opt_state,
+            "model_state": state.model_state,
         }
-        if state.model_state:
-            out["model_state"] = state.model_state
-        return out
     return state
 
 

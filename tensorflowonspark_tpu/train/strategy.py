@@ -160,20 +160,42 @@ class SyncDataParallel:
         return jax.jit(_init, out_shardings=shardings)()
 
     def _opt_shardings(self, state_shape):
-        """Opt-state shardings: any leaf whose shape matches a param leaf gets
-        that param's sharding (Adam moments mirror params); everything else
-        (counts, scalars) replicates."""
+        """Opt-state shardings, matched *structurally*: optax states embed
+        whole param-shaped subtrees (Adam's mu/nu, momentum's trace), so any
+        opt-state subtree whose treedef and leaf shapes mirror the params gets
+        the params' sharding tree; everything else (counts, scalars)
+        replicates. A by-shape lookup would misplace moments when two
+        same-shaped params carry different PartitionSpecs; still, leaves in
+        subtrees that do NOT fully mirror the params (e.g. optax.masked
+        moments with MaskedNode sentinels) fall back to a per-leaf
+        shape-match so large moment arrays keep their sharding instead of
+        blowing up replicated."""
         param_shardings = self.param_shardings(state_shape.params)
-        by_shape = {}
-        for p_leaf, s in zip(
-            jax.tree.leaves(state_shape.params), jax.tree.leaves(param_shardings)
-        ):
-            by_shape.setdefault((p_leaf.shape, p_leaf.dtype), s)
+        params_def = jax.tree.structure(state_shape.params)
+        param_leaves = jax.tree.leaves(state_shape.params)
         rep = replicated(self.mesh)
-        return jax.tree.map(
-            lambda leaf: by_shape.get((leaf.shape, leaf.dtype), rep),
-            state_shape.opt_state,
-        )
+        by_shape = {}
+        for p_leaf, s in zip(param_leaves, jax.tree.leaves(param_shardings)):
+            by_shape.setdefault((p_leaf.shape, p_leaf.dtype), s)
+
+        def _is_param_like(sub):
+            if jax.tree.structure(sub) != params_def:
+                return False
+            leaves = jax.tree.leaves(sub)
+            return all(
+                getattr(a, "shape", None) == b.shape
+                and getattr(a, "dtype", None) == b.dtype
+                for a, b in zip(leaves, param_leaves)
+            )
+
+        def _assign(sub):
+            if _is_param_like(sub):
+                return param_shardings
+            return by_shape.get(
+                (getattr(sub, "shape", None), getattr(sub, "dtype", None)), rep
+            )
+
+        return jax.tree.map(_assign, state_shape.opt_state, is_leaf=_is_param_like)
 
     # -- compiled steps --------------------------------------------------------
 
@@ -189,16 +211,28 @@ class SyncDataParallel:
         The gradient all-reduce (pure DP) or reduce-scatter+all-gather (FSDP)
         is inserted by XLA from the shardings — the moral equivalent of the
         reference's `all_reduce_alg`/NCCL configuration, with zero user code.
+
+        A ``loss_fn`` that declares a ``step`` keyword receives the current
+        ``state.step`` — the supported way to vary per-step randomness
+        (dropout rngs) without smuggling counters through the batch.
         """
+        import inspect
+
         import optax
 
+        try:
+            wants_step = "step" in inspect.signature(loss_fn).parameters
+        except (TypeError, ValueError):
+            wants_step = False
+
         def step(state, batch):
+            kw = {"step": state.step} if wants_step else {}
             if mutable:
                 (loss, (model_state, aux)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
-                )(state.params, state.model_state, batch)
+                )(state.params, state.model_state, batch, **kw)
             else:
-                out = jax.value_and_grad(loss_fn, has_aux=has_aux)(state.params, batch)
+                out = jax.value_and_grad(loss_fn, has_aux=has_aux)(state.params, batch, **kw)
                 (loss, aux), grads = out if has_aux else ((out[0], None), out[1])
                 model_state = state.model_state
             updates, opt_state = optimizer.update(grads, state.opt_state, state.params)

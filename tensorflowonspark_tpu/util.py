@@ -11,10 +11,34 @@ process (reference: util.py:77-86 + TFSparkNode.py:97-123).
 import errno
 import json
 import logging
+import multiprocessing
 import os
 import socket
 
 logger = logging.getLogger(__name__)
+
+_mp_spawn = multiprocessing.get_context("spawn")
+
+
+def _spawn_trampoline(blob):
+    import cloudpickle
+
+    cloudpickle.loads(blob)()
+
+
+def spawn_process(fn, name=None):
+    """A ``multiprocessing.Process`` running ``fn()`` in a **spawned** child.
+
+    Spawn (not fork) everywhere: executors, IPC servers, and jax children are
+    all started from processes that may carry threads (pytest, jax's own
+    thread pools, queue feeders), and forking a threaded process deadlocks —
+    python 3.12 warns about exactly this. ``fn`` may be any cloudpickle-able
+    zero-arg callable (closures included); a spawned child only needs the
+    module-level trampoline to be importable.
+    """
+    import cloudpickle
+
+    return _mp_spawn.Process(target=_spawn_trampoline, args=(cloudpickle.dumps(fn),), name=name)
 
 # Name of the per-executor state file written into the executor's CWD.
 EXECUTOR_STATE_FILE = "tos_tpu_executor.json"

@@ -1,0 +1,73 @@
+"""Native C++ TFRecord IO (native/tfrecord_io.cc via ctypes) vs the pure
+Python codec — byte-for-byte interchange and corruption detection.
+
+The reference's native IO layer was borrowed (tensorflow-hadoop jar +
+TensorFlow's C++ record_reader); ours is in-repo, so it gets the test the
+reference never had.
+"""
+
+import os
+
+import pytest
+
+from tensorflowonspark_tpu import native_io, tfrecord
+
+pytestmark = pytest.mark.skipif(
+    not native_io.available(), reason="native toolchain unavailable"
+)
+
+
+def test_masked_crc_matches_python():
+    for data in [b"", b"x", b"hello world", os.urandom(7), os.urandom(8), os.urandom(1000)]:
+        assert native_io.masked_crc32c(data) == tfrecord._masked_crc(data)
+
+
+def test_native_write_python_read(tmp_path):
+    recs = [os.urandom(i * 13 + 1) for i in range(40)] + [b""]
+    path = str(tmp_path / "native.tfrecord")
+    assert native_io.write_records(path, recs) == len(recs)
+    assert list(tfrecord.read_records(path)) == recs
+
+
+def test_python_write_native_read(tmp_path):
+    recs = [os.urandom(i * 13 + 1) for i in range(40)]
+    path = str(tmp_path / "python.tfrecord")
+    with tfrecord.TFRecordWriter(path) as w:
+        for r in recs:
+            w.write(r)
+    assert native_io.read_records(path) == recs
+
+
+def test_corruption_detected(tmp_path):
+    path = str(tmp_path / "good.tfrecord")
+    native_io.write_records(path, [b"payload-one", b"payload-two"])
+    blob = bytearray(open(path, "rb").read())
+    blob[14] ^= 0xFF  # flip a payload byte of record 0
+    bad = str(tmp_path / "bad.tfrecord")
+    open(bad, "wb").write(bytes(blob))
+    with pytest.raises(IOError):
+        native_io.read_records(bad)
+    # verify_crc=False skips the check and returns the (corrupt) payloads
+    assert len(native_io.read_records(bad, verify_crc=False)) == 2
+
+
+def test_empty_file(tmp_path):
+    path = str(tmp_path / "empty.tfrecord")
+    open(path, "wb").close()
+    assert native_io.read_records(path) == []
+
+
+def test_tf_interop(tmp_path):
+    """The native framing must be readable by TensorFlow itself."""
+    tf = pytest.importorskip("tensorflow")
+    recs = [b"alpha", b"beta", os.urandom(100)]
+    path = str(tmp_path / "interop.tfrecord")
+    native_io.write_records(path, recs)
+    got = [bytes(x.numpy()) for x in tf.data.TFRecordDataset(path)]
+    assert got == recs
+    # and the other direction
+    path2 = str(tmp_path / "tfwrote.tfrecord")
+    with tf.io.TFRecordWriter(path2) as w:
+        for r in recs:
+            w.write(r)
+    assert native_io.read_records(path2) == recs
