@@ -1,39 +1,54 @@
-"""Benchmark: ResNet-50 synthetic-data training throughput, images/sec/chip.
+"""Benchmarks for the two BASELINE.json metrics. Prints exactly ONE JSON line.
 
-Matches BASELINE.json's metric ("ResNet-50 ImageNet images/sec/chip"): one
-full training step (fwd + bwd + SGD-momentum update + BatchNorm stats) on
-synthetic 224x224x3 data, bfloat16 compute, timed on this host's chip(s).
+Modes (BENCH_MODE env):
 
-The reference repo publishes no numbers (BASELINE.md), so ``vs_baseline``
-is computed against ``REFERENCE_IMG_PER_SEC_PER_CHIP`` — the Cloud-TPU
-reference throughput the north-star target is phrased against ("≥70% of
-Cloud-TPU reference images/sec on a v5e"); vs_baseline ≥ 0.7 meets the bar.
+* ``resnet`` (default) — ResNet-50 training throughput, images/sec/chip:
+  one full step (fwd+bwd+SGD-momentum+BatchNorm) on synthetic 224x224x3,
+  bfloat16. Matches BASELINE.json metric 1.
+* ``resnet_real`` — same model, REAL input path: ImageNet-schema TFRecords
+  (JPEG bytes) written once to a temp dir, then read/decoded/augmented by
+  the framework input pipeline (tensorflowonspark_tpu.data) feeding the
+  device with double-buffering — end-to-end images/sec/chip.
+* ``mnist_epoch`` — BASELINE.json metric 2, "MNIST epoch time
+  (InputMode.SPARK)": wall-clock seconds to push one epoch of MNIST-shaped
+  rows through a live 1-worker cluster's feed plane (reservation server,
+  executor IPC channel, chunked queue puts, DataFeed consume + train step).
+  ``vs_baseline`` here is the measured speedup over the reference's
+  feed design (one pickled row per Manager round trip — its hot loop,
+  reference TFSparkNode.py:430-434), i.e. per-row-feed epoch time divided
+  by chunked epoch time on the same machine.
 
-Env knobs: BENCH_TINY=1 (CPU-friendly shapes for smoke runs),
-BENCH_BATCH, BENCH_STEPS.
+``REFERENCE_IMG_PER_SEC_PER_CHIP`` — the constant behind ``vs_baseline`` in
+the resnet modes. The reference repo publishes no numbers (BASELINE.md), so
+the bar is stated against hardware arithmetic: ResNet-50 is ~4.1 GFLOPs per
+224x224 forward pass, ~3x that for a training step (~12.3 GFLOPs/image); a
+v5e chip peaks at 197 bf16 TFLOP/s, so 2000 img/s/chip corresponds to ~12.5%
+MXU utilization — a deliberately conservative stand-in for the "Cloud-TPU
+reference images/sec" in BASELINE.json's >=70% target (well-tuned ResNet/TPU
+runs reach 30-50% MXU utilization; beating 0.7x of this constant is the
+floor, not the ceiling).
 
-Prints exactly one JSON line.
+Env knobs: BENCH_TINY=1 (CPU-friendly shapes), BENCH_BATCH, BENCH_STEPS,
+BENCH_MNIST_ROWS.
 """
 
 import json
 import os
 import time
 
-
-#: Cloud-TPU reference ResNet-50 training throughput per v5e chip (bf16,
-#: batch 128/chip) that the BASELINE.json target is measured against.
 REFERENCE_IMG_PER_SEC_PER_CHIP = 2000.0
 
 
-def main():
-    tiny = os.environ.get("BENCH_TINY") == "1"
+def _force_platform_for_tiny(tiny):
     if tiny:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
 
-    import jax
-
-    if tiny:
         jax.config.update("jax_platforms", "cpu")
+
+
+def bench_resnet(tiny, real_data):
+    import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -63,39 +78,151 @@ def main():
         resnet.make_loss_fn(model, weight_decay=1e-4), optimizer, mutable=True
     )
 
-    rng = np.random.default_rng(0)
-    host_batch = {
-        "image": rng.standard_normal((batch, image_size, image_size, 3)).astype(np.float32),
-        "label": rng.integers(0, 10 if tiny else 1000, batch),
-    }
-    sharded = strategy.shard_batch(host_batch)
+    tmp = None
+    if real_data:
+        import tempfile
 
-    # warmup: compile + 2 steady steps
-    for _ in range(3):
-        state, metrics = step(state, sharded)
-    jax.block_until_ready(metrics["loss"])
+        from tensorflowonspark_tpu import tfrecord
+        from tensorflowonspark_tpu.data import ImagePipeline, device_prefetch, imagenet
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, sharded)
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
-
-    img_per_sec_per_chip = batch * steps / dt / n_chips
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_train_images_per_sec_per_chip"
-                if not tiny
-                else "resnet56_tiny_train_images_per_sec_per_chip",
-                "value": round(img_per_sec_per_chip, 2),
-                "unit": "images/sec/chip",
-                "vs_baseline": round(
-                    img_per_sec_per_chip / REFERENCE_IMG_PER_SEC_PER_CHIP, 4
-                ),
-            }
+        rng = np.random.default_rng(0)
+        tmp = tempfile.mkdtemp(prefix="bench_imagenet_")
+        n_images = max(batch * 4, 256)
+        per_shard = n_images // 4
+        for s in range(4):
+            with tfrecord.TFRecordWriter(os.path.join(tmp, "part-{:05d}".format(s))) as w:
+                for _ in range(per_shard):
+                    img = rng.integers(0, 256, (image_size + 32, image_size + 32, 3), dtype=np.uint8)
+                    w.write(imagenet.encode_example(img, int(rng.integers(0, 10 if tiny else 1000))))
+        pipe = ImagePipeline(
+            tfrecord.list_shards(tmp),
+            imagenet.make_parse_fn(True, image_size=image_size),
+            batch, epochs=None, num_threads=int(os.environ.get("BENCH_DATA_THREADS", "8")),
         )
+        batches = device_prefetch(pipe, strategy)
+    else:
+        rng = np.random.default_rng(0)
+        host_batch = {
+            "image": rng.standard_normal((batch, image_size, image_size, 3)).astype(np.float32),
+            "label": rng.integers(0, 10 if tiny else 1000, batch),
+        }
+        sharded = strategy.shard_batch(host_batch)
+        batches = iter(lambda: sharded, None)
+
+    try:
+        for _ in range(3):  # warmup: compile + steady state
+            state, metrics = step(state, next(batches))
+        jax.block_until_ready(metrics["loss"])
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, next(batches))
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t0
+    finally:
+        if tmp:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    value = batch * steps / dt / n_chips
+    name = "resnet56_tiny" if tiny else "resnet50"
+    suffix = "_realdata" if real_data else ""
+    return {
+        "metric": "{}{}_train_images_per_sec_per_chip".format(name, suffix),
+        "value": round(value, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / REFERENCE_IMG_PER_SEC_PER_CHIP, 4),
+    }
+
+
+def _mnist_epoch_once(sc, rows, batch_size):
+    """One full InputMode.SPARK epoch through a live cluster; returns secs."""
+    from tensorflowonspark_tpu import TFCluster
+
+    cluster = TFCluster.run(
+        sc, _mnist_bench_fun, {"batch_size": batch_size}, 1,
+        input_mode=TFCluster.InputMode.SPARK, master_node=None,
+        env={"JAX_PLATFORMS": "cpu"}, jax_distributed=False, reservation_timeout=120,
     )
+    # warmup epoch: jax import + train-step compile in the child, so the
+    # timed epoch measures the feed plane + steady-state steps
+    cluster.train(sc.parallelize(rows[: 4 * batch_size], 2), num_epochs=1, feed_timeout=600)
+    t0 = time.perf_counter()
+    cluster.train(sc.parallelize(rows, 4), num_epochs=1, feed_timeout=600)
+    # train() returns when the queues are drained = epoch consumed
+    dt = time.perf_counter() - t0
+    cluster.shutdown(grace_secs=2, timeout=300)
+    return dt
+
+
+def _mnist_bench_fun(args, ctx):
+    """Consumes the feed and runs a real train step per batch (jax child)."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import parallel
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.train import SyncDataParallel
+
+    strategy = SyncDataParallel(parallel.local_mesh({"dp": -1}))
+    model = mnist.create_model("mlp")
+    optimizer = optax.sgd(0.1)
+    state = strategy.create_state(mnist.make_init_fn(model), optimizer, jax.random.PRNGKey(0))
+    step = strategy.compile_train_step(mnist.make_loss_fn(model), optimizer, has_aux=True)
+    feed = ctx.get_data_feed(train_mode=True)
+    bs = args["batch_size"]
+    while not feed.should_stop():
+        batch = feed.next_batch(bs)
+        if len(batch) < bs:
+            break
+        images = np.asarray([b[0] for b in batch], np.float32).reshape(-1, 28, 28)
+        labels = np.asarray([b[1] for b in batch])
+        state, metrics = step(state, strategy.shard_batch({"image": images, "label": labels}))
+        jax.block_until_ready(metrics["loss"])
+
+
+def bench_mnist_epoch():
+    """Epoch wall time through the cluster feed plane, chunked vs per-row."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import TFSparkNode
+    from tensorflowonspark_tpu.backends.local import LocalSparkContext
+
+    n = int(os.environ.get("BENCH_MNIST_ROWS", "4096"))
+    batch_size = 64
+    rng = np.random.default_rng(0)
+    rows = [
+        (rng.standard_normal(784).astype(np.float32).tolist(), int(i % 10))
+        for i in range(n)
+    ]
+
+    times = {}
+    for label, chunk in (("chunked", TFSparkNode.FEED_CHUNK_SIZE), ("per_row", 1)):
+        TFSparkNode.FEED_CHUNK_SIZE = chunk  # module default picked up by tasks
+        sc = LocalSparkContext(num_executors=1, task_timeout=900)
+        try:
+            times[label] = _mnist_epoch_once(sc, rows, batch_size)
+        finally:
+            sc.stop()
+    return {
+        "metric": "mnist_epoch_time_inputmode_spark",
+        "value": round(times["chunked"], 2),
+        "unit": "seconds ({} rows, batch {})".format(n, batch_size),
+        "vs_baseline": round(times["per_row"] / times["chunked"], 2),
+    }
+
+
+def main():
+    tiny = os.environ.get("BENCH_TINY") == "1"
+    mode = os.environ.get("BENCH_MODE", "resnet")
+    _force_platform_for_tiny(tiny or mode == "mnist_epoch")
+    if mode == "mnist_epoch":
+        result = bench_mnist_epoch()
+    else:
+        result = bench_resnet(tiny, real_data=(mode == "resnet_real"))
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -4,14 +4,20 @@ Parity with /root/reference/examples/resnet/resnet_cifar_spark.py +
 resnet_imagenet_main.py: ``--dataset cifar`` trains ResNet-56 (batch 128,
 piecewise LR like resnet_cifar_dist.py:34-36), ``--dataset imagenet`` trains
 ResNet-50 v1.5 (base LR 0.1·bs/256 with warmup like
-resnet_imagenet_main.py:37-71). ``--use_synthetic_data`` mirrors the
-reference's synthetic input path (common.py:315) and is the default here
-(no dataset downloads in this environment); bf16 compute replaces the
-reference's fp16+LossScaleOptimizer.
+resnet_imagenet_main.py:37-71). bf16 compute replaces the reference's
+fp16+LossScaleOptimizer.
+
+Input paths, matching the reference's two modes:
+* ``--data_dir <tfrecords>`` — REAL data: TFRecord shards read through the
+  framework input pipeline (tensorflowonspark_tpu.data: native bulk reads,
+  threaded decode/crop/flip/normalize, per-worker file sharding, device
+  double-buffering — the imagenet_preprocessing.py:259 input_fn analogue).
+* ``--use_synthetic_data`` — the reference's synthetic path (common.py:315),
+  default when no --data_dir is given.
 
 Usage:
     python examples/resnet/resnet_spark.py --dataset cifar --train_steps 100 \
-        --use_synthetic_data
+        --data_dir /data/cifar_tfrecords
 """
 
 import argparse
@@ -64,17 +70,51 @@ def main_fun(args, ctx):
         resnet.make_loss_fn(model, weight_decay=1e-4), optimizer, mutable=True
     )
 
-    rng = np.random.default_rng(ctx.executor_id)
-    batch = strategy.shard_batch(
-        {
-            "image": rng.standard_normal((args.batch_size, image_size, image_size, 3)).astype(np.float32),
-            "label": rng.integers(0, classes, args.batch_size),
-        }
-    )
+    if args.data_dir and not args.use_synthetic_data:
+        # REAL data: per-worker file shards → threaded decode/augment →
+        # device double-buffering (InputMode.TENSORFLOW per-worker sharding,
+        # reference mnist_inference.py:42 ds.shard + input_fn)
+        from tensorflowonspark_tpu import tfrecord as tfr
+        from tensorflowonspark_tpu.data import ImagePipeline, device_prefetch, shard_files
+        from tensorflowonspark_tpu.data import cifar as cifar_data
+        from tensorflowonspark_tpu.data import imagenet as imagenet_data
+
+        all_files = tfr.list_shards(args.data_dir)
+        files = shard_files(all_files, ctx.num_workers, ctx.executor_id)
+        if not files:
+            # fail loudly NOW: a worker with no data would sit out the
+            # collective train steps and hang the whole world at step 1
+            raise RuntimeError(
+                "worker {} got 0 of {} shard files in {} — distributed "
+                "training needs at least num_workers ({}) shard files".format(
+                    ctx.executor_id, len(all_files), args.data_dir, ctx.num_workers
+                )
+            )
+        parse = (
+            cifar_data.make_parse_fn(True, seed=ctx.executor_id)
+            if args.dataset == "cifar"
+            else imagenet_data.make_parse_fn(
+                True, image_size=image_size, label_offset=args.label_offset, seed=ctx.executor_id
+            )
+        )
+        pipe = ImagePipeline(
+            files, parse, args.batch_size, seed=ctx.executor_id, epochs=None,
+            num_threads=args.data_threads,
+        )
+        batches = device_prefetch(pipe, strategy)
+    else:
+        rng = np.random.default_rng(ctx.executor_id)
+        synthetic = strategy.shard_batch(
+            {
+                "image": rng.standard_normal((args.batch_size, image_size, image_size, 3)).astype(np.float32),
+                "label": rng.integers(0, classes, args.batch_size),
+            }
+        )
+        batches = iter(lambda: synthetic, None)  # repeat forever
+
     t0, metrics = time.perf_counter(), {}
     for i in range(args.train_steps):
-        if not args.use_synthetic_data:
-            raise NotImplementedError("real-data input pipeline: use TFRecords via mnist_tf.py pattern")
+        batch = next(batches)
         state, metrics = step(state, batch)
         if (i + 1) % args.log_steps == 0:
             jax.block_until_ready(metrics["loss"])
@@ -86,18 +126,32 @@ def main_fun(args, ctx):
     if metrics:
         jax.block_until_ready(metrics["loss"])
         print("final loss {:.3f}".format(float(metrics["loss"])))
+        if args.model_dir and (ctx.distributed or ctx.job_name in ("chief", "master")):
+            from tensorflowonspark_tpu.train import checkpoint
+
+            checkpoint.save_checkpoint(
+                os.path.join(args.model_dir, "ckpt_{}".format(args.train_steps)),
+                jax.device_get(state),
+            )
 
 
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch_size", type=int, default=128)
     parser.add_argument("--cluster_size", type=int, default=1)
+    parser.add_argument("--data_dir", default=None, help="TFRecord shard dir (real-data mode)")
+    parser.add_argument("--data_threads", type=int, default=8)
     parser.add_argument("--dataset", choices=["cifar", "imagenet"], default="cifar")
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16")
+    parser.add_argument("--label_offset", type=int, default=0,
+                        help="-1 for 1-based ImageNet labels")
     parser.add_argument("--log_steps", type=int, default=20)
+    parser.add_argument("--model_dir", default=None)
     parser.add_argument("--steps_per_epoch", type=int, default=390)
     parser.add_argument("--train_steps", type=int, default=100)
-    parser.add_argument("--use_synthetic_data", action="store_true", default=True)
+    parser.add_argument("--use_synthetic_data", action="store_true", default=False,
+                        help="force the synthetic path even when --data_dir is given; "
+                             "synthetic is also the default when no --data_dir is set")
     parser.add_argument("--platform", default=None)
     args = parser.parse_args(argv)
 

@@ -235,7 +235,10 @@ def run(
         eid for eid, (job, _) in template.items() if job in ("chief", "master", "worker")
     ]
     if jax_distributed is None:
-        jax_distributed = num_workers > 1 and not (env or {}).get("JAX_PLATFORMS") == "cpu"
+        # default: any multi-worker cluster forms a jax.distributed world —
+        # including CPU ones, where collectives ride gloo (the test analogue
+        # of multi-host ICI/DCN; see TFNodeContext.initialize_distributed)
+        jax_distributed = num_workers > 1
     logger.info("cluster template: %s", {e: "{}:{}".format(j, t) for e, (j, t) in template.items()})
 
     server = reservation.Server(num_executors)

@@ -13,10 +13,11 @@ TPU-native differences:
   so a batch can go straight onto the chips without a Python-loop transpose.
 """
 
+import collections
 import getpass
 import logging
 
-from tensorflowonspark_tpu.marker import EndPartition
+from tensorflowonspark_tpu.marker import Chunk, EndPartition
 
 logger = logging.getLogger(__name__)
 
@@ -109,19 +110,43 @@ class DataFeed:
         self.input_tensors = (
             [input_mapping[col] for col in sorted(input_mapping)] if input_mapping else None
         )
+        #: rows unwrapped from a partially-consumed Chunk, served before the
+        #: next proxied queue get (the consumer half of feed-plane chunking)
+        self._pending = collections.deque()
+        #: a dequeued Chunk whose task_done is deferred until every row is
+        #: consumed — keeps the feeder's unfinished()==0 wait meaning "all
+        #: rows trained", not "all messages dequeued"
+        self._chunk_open = False
 
     def next_batch(self, batch_size, as_numpy=False):
         """Get up to ``batch_size`` items from the feed queue.
 
         Returns a list of items, or — when ``input_mapping`` was supplied — a
         dict of columns keyed by tensor name. ``as_numpy=True`` stacks columns
-        into numpy arrays (device-put ready).
+        into numpy arrays (device-put ready). One proxied queue get fetches a
+        whole :class:`~tensorflowonspark_tpu.marker.Chunk` of rows (vs the
+        reference's one-round-trip-per-row loop, TFNode.py:243-288).
         """
         logger.debug("next_batch(%d)", batch_size)
         queue_in = self.mgr.get_queue(self.qname_in)
         tensors = [] if self.input_tensors is None else {t: [] for t in self.input_tensors}
         count = 0
+
+        def _consume(row):
+            if self.input_tensors is None:
+                tensors.append(row)
+            else:
+                for i, t in enumerate(self.input_tensors):
+                    tensors[t].append(row[i])
+
         while count < batch_size:
+            if self._pending:
+                _consume(self._pending.popleft())
+                count += 1
+                if not self._pending and self._chunk_open:
+                    queue_in.task_done()  # whole chunk now consumed
+                    self._chunk_open = False
+                continue
             item = queue_in.get(block=True)
             if item is None:
                 # end-of-feed marker from shutdown (TFSparkNode.py:560-569)
@@ -135,12 +160,14 @@ class DataFeed:
                 queue_in.task_done()
                 if count > 0:
                     break
+            elif isinstance(item, Chunk):
+                # task_done deferred until the last row is consumed
+                self._pending.extend(item.items)
+                self._chunk_open = bool(self._pending)
+                if not self._pending:  # defensive: empty chunk
+                    queue_in.task_done()
             else:
-                if self.input_tensors is None:
-                    tensors.append(item)
-                else:
-                    for i, t in enumerate(self.input_tensors):
-                        tensors[t].append(item[i])
+                _consume(item)
                 count += 1
                 queue_in.task_done()
         logger.debug("next_batch: returning %d items", count)
@@ -157,11 +184,10 @@ class DataFeed:
         return self.done_feeding
 
     def batch_results(self, results):
-        """Push a batch of inference results to the output queue; the contract
-        is 1:1 with consumed inputs (reference TFNode.py:294-305)."""
-        queue_out = self.mgr.get_queue(self.qname_out)
-        for item in results:
-            queue_out.put(item, block=True)
+        """Push a batch of inference results to the output queue — one
+        chunked message per call; the contract stays 1:1 row-for-row with
+        consumed inputs (reference TFNode.py:294-305)."""
+        self.mgr.get_queue(self.qname_out).put(Chunk(results), block=True)
 
     def terminate(self):
         """Request feeder termination: flips the executor state machine to

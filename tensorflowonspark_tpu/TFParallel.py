@@ -66,6 +66,10 @@ class _ParallelTask:
                         chip_ids=chip_ids, platform=self.env.get("JAX_PLATFORMS")
                     )
                 )
+                if self.env.get("JAX_PLATFORMS"):
+                    util.force_platform(
+                        self.env["JAX_PLATFORMS"], self.env.get("TOS_NUM_CPU_DEVICES")
+                    )
                 self.fn(self.tf_args, ctx)
             except BaseException:
                 logger.error("TFParallel fn failed:\n%s", traceback.format_exc())

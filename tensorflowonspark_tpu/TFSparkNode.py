@@ -28,7 +28,12 @@ import time
 import traceback
 
 from tensorflowonspark_tpu import TFManager, TFNode, reservation, tpu_info, util
-from tensorflowonspark_tpu.marker import EndPartition
+from tensorflowonspark_tpu.marker import Chunk, EndPartition
+
+#: rows per proxied queue message on the feed plane (amortizes the Manager
+#: round trip that was the reference's hot-loop bottleneck; overridable for
+#: huge rows via env)
+FEED_CHUNK_SIZE = int(os.environ.get("TOS_FEED_CHUNK", "100"))
 
 logger = logging.getLogger(__name__)
 
@@ -110,10 +115,23 @@ class TFNodeContext:
             return
         import jax
 
+        platforms = str(getattr(jax.config, "jax_platforms", None) or "")
+        if platforms.split(",")[0] == "cpu":
+            # CPU multi-process worlds (tests, dev boxes) federate their
+            # devices through gloo collectives; on TPU the ICI/DCN transport
+            # is native and needs no selection
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:  # older jax: single implementation only
+                pass
         jax.distributed.initialize(
             coordinator_address=self.coordinator_address,
             num_processes=self.num_processes,
             process_id=self.process_id,
+        )
+        logger.info(
+            "jax.distributed world up: %d processes, this is %d, %d global device(s)",
+            self.num_processes, self.process_id, jax.device_count(),
         )
 
     def mesh(self, axes=None):
@@ -160,6 +178,11 @@ def _child_entry(fn, tf_args, ctx, cluster_meta, error_queue_spec):
         env = cluster_meta.get("env") or {}
         os.environ.update(env)
         os.environ.update(tpu_info.visibility_env(platform=env.get("JAX_PLATFORMS")))
+        if env.get("JAX_PLATFORMS"):
+            # config-API forcing: on TPU-pod images the site setup pins the
+            # platform via jax.config in every interpreter, which overrides
+            # the env var we just set (see util.force_platform)
+            util.force_platform(env["JAX_PLATFORMS"], env.get("TOS_NUM_CPU_DEVICES"))
         # re-connect our own IPC channel from inside the child
         addr, authkey = error_queue_spec
         ctx.mgr = TFManager.connect(addr, authkey)
@@ -447,10 +470,11 @@ class _TrainPartitionTask:
     """Feeds one RDD partition into the executor's input queue
     (reference ``TFSparkNode.train()._train``, TFSparkNode.py:400-467)."""
 
-    def __init__(self, cluster_meta, qname="input", feed_timeout=600):
+    def __init__(self, cluster_meta, qname="input", feed_timeout=600, chunk_size=None):
         self.cluster_meta = cluster_meta
         self.qname = qname
         self.feed_timeout = feed_timeout
+        self.chunk_size = chunk_size or FEED_CHUNK_SIZE
 
     def __call__(self, iterator):
         _state, mgr = _connect_executor_channel()
@@ -461,9 +485,15 @@ class _TrainPartitionTask:
             return []
         q = mgr.get_queue(self.qname)
         count = 0
+        buf = []
         for item in iterator:
-            q.put(item, block=True)
+            buf.append(item)
             count += 1
+            if len(buf) >= self.chunk_size:
+                q.put(Chunk(buf), block=True)
+                buf = []
+        if buf:
+            q.put(Chunk(buf), block=True)
         logger.info("fed %d items to queue %r; waiting for consumption", count, self.qname)
         deadline = time.time() + self.feed_timeout
         while q.unfinished() > 0:
@@ -493,19 +523,26 @@ class _InferencePartitionTask:
     """Feeds one partition and collects exactly its results
     (reference ``TFSparkNode.inference()._inference``, TFSparkNode.py:470-529)."""
 
-    def __init__(self, cluster_meta, qname_in="input", qname_out="output", feed_timeout=600):
+    def __init__(self, cluster_meta, qname_in="input", qname_out="output", feed_timeout=600, chunk_size=None):
         self.cluster_meta = cluster_meta
         self.qname_in = qname_in
         self.qname_out = qname_out
         self.feed_timeout = feed_timeout
+        self.chunk_size = chunk_size or FEED_CHUNK_SIZE
 
     def __call__(self, iterator):
         _state, mgr = _connect_executor_channel()
         q = mgr.get_queue(self.qname_in)
         count = 0
+        buf = []
         for item in iterator:
-            q.put(item, block=True)
+            buf.append(item)
             count += 1
+            if len(buf) >= self.chunk_size:
+                q.put(Chunk(buf), block=True)
+                buf = []
+        if buf:
+            q.put(Chunk(buf), block=True)
         q.put(EndPartition(), block=True)
         if count == 0:
             return []
@@ -518,8 +555,12 @@ class _InferencePartitionTask:
         out = mgr.get_queue(self.qname_out)
         results = []
         while len(results) < count:
-            results.append(out.get(block=True, timeout=self.feed_timeout))
+            item = out.get(block=True, timeout=self.feed_timeout)
             out.task_done()
+            if isinstance(item, Chunk):
+                results.extend(item.items)
+            else:
+                results.append(item)
         logger.info("collected %d inference results", len(results))
         return results
 

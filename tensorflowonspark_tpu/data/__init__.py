@@ -1,0 +1,18 @@
+"""Host-side input pipelines feeding the TPU (the InputMode.TENSORFLOW perf
+path).
+
+The reference shipped its input pipeline as example code driving tf.data
+(/root/reference/examples/resnet/imagenet_preprocessing.py:259 input_fn,
+cifar_preprocessing.py:42 parse_record); here it is a framework subpackage:
+TFRecord shards are bulk-read through the native C++ reader
+(:mod:`tensorflowonspark_tpu.native_io`), images decoded/augmented with
+PIL+numpy on a thread pool, and fixed-shape batches double-buffered onto the
+device mesh — static shapes and steady feed keep XLA and the MXU busy.
+"""
+
+from tensorflowonspark_tpu.data.loader import (  # noqa: F401
+    ImagePipeline,
+    device_prefetch,
+    shard_files,
+)
+from tensorflowonspark_tpu.data import cifar, imagenet  # noqa: F401

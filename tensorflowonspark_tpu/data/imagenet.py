@@ -1,0 +1,124 @@
+"""ImageNet-style record parsing and augmentation (PIL + numpy).
+
+Capability-parity with the reference's pipeline
+(/root/reference/examples/resnet/imagenet_preprocessing.py: record schema
+:156-223, distorted-bbox crop+flip :326-373, aspect-preserving resize +
+central crop for eval :375-501, channel-mean subtraction :397-430), built
+host-side without TensorFlow: decode and resize ride PIL's C codecs on the
+executor/TPU-host CPUs, the TPU never sees a dynamic shape.
+
+Record schema (the de-facto ImageNet TFRecord layout the reference parses):
+``image/encoded`` JPEG bytes, ``image/class/label`` int64.
+"""
+
+import io
+import logging
+
+import numpy as np
+
+from tensorflowonspark_tpu import tfrecord
+
+logger = logging.getLogger(__name__)
+
+IMAGE_SIZE = 224
+#: standard per-channel RGB means (same constants the reference subtracts,
+#: imagenet_preprocessing.py:54-57)
+CHANNEL_MEANS = np.array([123.68, 116.78, 103.94], np.float32)
+#: eval-time aspect-preserving resize target for the short side
+RESIZE_MIN = 256
+
+NUM_CLASSES = 1000
+NUM_IMAGES = {"train": 1281167, "validation": 50000}
+
+
+def _decode(image_bytes):
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(image_bytes))
+    if img.mode != "RGB":
+        img = img.convert("RGB")
+    return img
+
+
+def _random_crop_box(width, height, rng, area_range=(0.05, 1.0), aspect_range=(0.75, 1.33), attempts=10):
+    """Inception-style distorted bounding box: sample a crop whose area and
+    aspect ratio fall in the given ranges; fall back to a central square
+    (the reference's sample_distorted_bounding_box fallback,
+    imagenet_preprocessing.py:326-373)."""
+    area = width * height
+    for _ in range(attempts):
+        target_area = rng.uniform(*area_range) * area
+        aspect = rng.uniform(*aspect_range)
+        w = int(round(np.sqrt(target_area * aspect)))
+        h = int(round(np.sqrt(target_area / aspect)))
+        if w <= width and h <= height and w > 0 and h > 0:
+            x = rng.integers(0, width - w + 1)
+            y = rng.integers(0, height - h + 1)
+            return x, y, w, h
+    side = min(width, height)
+    return (width - side) // 2, (height - side) // 2, side, side
+
+
+def preprocess_train(image_bytes, rng, image_size=IMAGE_SIZE):
+    """JPEG bytes → float32 HWC: distorted crop, resize, random flip, mean
+    subtract."""
+    from PIL import Image
+
+    img = _decode(image_bytes)
+    x, y, w, h = _random_crop_box(img.width, img.height, rng)
+    img = img.resize((image_size, image_size), Image.BILINEAR, box=(x, y, x + w, y + h))
+    arr = np.asarray(img, np.float32)
+    if rng.random() < 0.5:
+        arr = arr[:, ::-1]
+    return arr - CHANNEL_MEANS
+
+
+def preprocess_eval(image_bytes, image_size=IMAGE_SIZE, resize_min=RESIZE_MIN):
+    """JPEG bytes → float32 HWC: aspect-preserving resize, central crop, mean
+    subtract (imagenet_preprocessing.py:375-501)."""
+    from PIL import Image
+
+    img = _decode(image_bytes)
+    scale = resize_min / min(img.width, img.height)
+    nw, nh = int(round(img.width * scale)), int(round(img.height * scale))
+    img = img.resize((nw, nh), Image.BILINEAR)
+    x = (nw - image_size) // 2
+    y = (nh - image_size) // 2
+    arr = np.asarray(img.crop((x, y, x + image_size, y + image_size)), np.float32)
+    return arr - CHANNEL_MEANS
+
+
+def make_parse_fn(is_training, image_size=IMAGE_SIZE, label_offset=0, seed=0):
+    """record bytes → (image f32 HWC, label int32).
+
+    ``label_offset`` handles 1-based ImageNet labels (pass -1 to map 1..1000
+    onto 0..999). The augmentation rng is keyed to (seed, crc32 of the record
+    bytes) so a seeded run applies identical crops/flips to each image no
+    matter how the thread pool schedules the parses.
+    """
+    import zlib
+
+    def parse(record):
+        feats = tfrecord.decode_example(record)
+        image_bytes = feats["image/encoded"][1][0]
+        label = int(feats["image/class/label"][1][0]) + label_offset
+        if is_training:
+            rng = np.random.default_rng((seed << 32) ^ zlib.crc32(record))
+            image = preprocess_train(image_bytes, rng, image_size)
+        else:
+            image = preprocess_eval(image_bytes, image_size)
+        return image, label
+
+    return parse
+
+
+def encode_example(image_array, label, quality=90):
+    """uint8 HWC array + label → serialized Example with JPEG bytes (for
+    dataset prep and tests; the write-side twin of :func:`make_parse_fn`)."""
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(np.asarray(image_array, np.uint8)).save(buf, "JPEG", quality=quality)
+    return tfrecord.encode_example(
+        {"image/encoded": [buf.getvalue()], "image/class/label": [int(label)]}
+    )

@@ -1,0 +1,169 @@
+"""Threaded TFRecord→batch pipeline with device double-buffering.
+
+The tf.data replacement for the InputMode.TENSORFLOW perf path (reference
+input_fn: imagenet_preprocessing.py:259-323 — shard per worker, shuffle,
+parallel parse, batch with drop_remainder, prefetch): shards are bulk-read
+through the native C++ reader when built (one FFI call per file,
+native/tfrecord_io.cc), records parsed on a thread pool (PIL/numpy release
+the GIL in their C cores), and fixed-shape batches handed out one step ahead
+of the device so the MXU never waits on the host.
+"""
+
+import logging
+import queue
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def shard_files(files, num_shards, index):
+    """Deterministic per-worker file sharding (the reference used
+    ``ds.shard(num_workers, worker_num)``, mnist_inference.py:42 — same
+    round-robin contract)."""
+    files = sorted(files)
+    if num_shards <= 1:
+        return list(files)
+    if index >= num_shards:
+        raise ValueError("shard index {} out of range for {} shards".format(index, num_shards))
+    return files[index::num_shards]
+
+
+def _read_shard(path, verify_crc=True):
+    """All raw records of one shard; native bulk reader when available."""
+    from tensorflowonspark_tpu import native_io, tfrecord
+
+    if native_io.available():
+        return native_io.read_records(path, verify_crc=verify_crc)
+    return list(tfrecord.read_records(path, verify_crc=verify_crc))
+
+
+class ImagePipeline:
+    """files → shuffled, parsed, fixed-shape batches of
+    ``{"image": f32 [B,H,W,C], "label": i32 [B]}``.
+
+    ``parse_fn(record_bytes) -> (image, label)`` comes from
+    :mod:`~tensorflowonspark_tpu.data.imagenet` / ``cifar``. Iterating yields
+    ``steps_per_epoch * epochs`` batches (``epochs=None`` repeats forever);
+    short final batches are dropped (static shapes for XLA, the reference's
+    ``drop_remainder=True``).
+    """
+
+    def __init__(
+        self,
+        files,
+        parse_fn,
+        batch_size,
+        shuffle=True,
+        seed=0,
+        num_threads=8,
+        epochs=1,
+        prefetch_batches=2,
+        verify_crc=False,
+    ):
+        if not files:
+            raise ValueError("no input files")
+        self.files = list(files)
+        self.parse_fn = parse_fn
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.num_threads = num_threads
+        self.epochs = epochs
+        self.prefetch_batches = prefetch_batches
+        self.verify_crc = verify_crc
+
+    def _record_stream(self):
+        rng = np.random.default_rng(self.seed)
+        epoch = 0
+        while self.epochs is None or epoch < self.epochs:
+            order = list(self.files)
+            if self.shuffle:
+                rng.shuffle(order)
+            for path in order:
+                records = _read_shard(path, self.verify_crc)
+                if self.shuffle:
+                    idx = rng.permutation(len(records))
+                    records = [records[i] for i in idx]
+                for rec in records:
+                    yield rec
+            epoch += 1
+
+    def __iter__(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        out_q = queue.Queue(maxsize=max(1, self.prefetch_batches))
+        stop = threading.Event()
+        _END = object()
+
+        def _final_put(item):
+            # never block forever on a departed consumer: its finally drains
+            # the queue and sets stop, so either the put lands or stop shows
+            while not stop.is_set():
+                try:
+                    out_q.put(item, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+        def producer():
+            try:
+                with ThreadPoolExecutor(self.num_threads) as pool:
+                    batch = []
+                    for rec in self._record_stream():
+                        if stop.is_set():
+                            return
+                        batch.append(rec)
+                        if len(batch) == self.batch_size:
+                            parsed = list(pool.map(self.parse_fn, batch))
+                            images = np.stack([p[0] for p in parsed]).astype(np.float32)
+                            labels = np.asarray([p[1] for p in parsed], np.int32)
+                            out_q.put({"image": images, "label": labels})
+                            batch = []
+                    # short remainder dropped: XLA wants one static shape
+            except BaseException as e:  # surfaced on the consuming side
+                _final_put(e)
+                return
+            finally:
+                _final_put(_END)
+
+        thread = threading.Thread(target=producer, name="tos-data-producer", daemon=True)
+        thread.start()
+        try:
+            while True:
+                item = out_q.get()
+                if item is _END:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            # unblock the producer if it is waiting on a full queue (empty()
+            # instead of catching Empty: exception classes may already be
+            # torn down when a half-consumed generator is GC'd at exit)
+            while not out_q.empty():
+                out_q.get_nowait()
+
+
+def device_prefetch(batches, strategy, depth=2):
+    """Shard host batches onto the mesh ``depth`` steps ahead of the consumer
+    (the ``tf.data.prefetch``-to-device analogue): while the device crunches
+    step N, the host is already transferring N+1."""
+    import collections
+
+    buf = collections.deque()
+    it = iter(batches)
+    try:
+        for _ in range(depth):
+            buf.append(strategy.shard_batch(next(it)))
+    except StopIteration:
+        pass
+    while buf:
+        out = buf.popleft()
+        try:
+            buf.append(strategy.shard_batch(next(it)))
+        except StopIteration:
+            pass
+        yield out

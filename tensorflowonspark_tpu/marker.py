@@ -22,6 +22,25 @@ class EndPartition(Marker):
     __slots__ = ()
 
 
+class Chunk(Marker):
+    """A block of consecutive feed items shipped as ONE queue message.
+
+    The feed plane's throughput unit: the reference pushed one pickled row
+    per Manager proxy call (its hot-loop bottleneck, TFSparkNode.py:430-434);
+    chunking amortizes the proxy round trip over ``len(items)`` rows. Fully
+    transparent to consumers — :class:`~tensorflowonspark_tpu.TFNode.DataFeed`
+    unwraps chunks and plain items alike.
+    """
+
+    __slots__ = ("items",)
+
+    def __init__(self, items):
+        self.items = list(items)
+
+    def __len__(self):
+        return len(self.items)
+
+
 #: The end-of-feed marker. Kept as ``None`` for wire-compat with the reference
 #: semantics (/root/reference/tensorflowonspark/TFNode.py:267).
 END_OF_FEED = None

@@ -3,10 +3,17 @@
 The reference's pipeline could reload *any* trained model because a TF
 SavedModel carries its own graph (/root/reference/tensorflowonspark/
 pipeline.py:585-644 introspects signatures at load time). A jax checkpoint
-carries only arrays, so the bundle format here is: an orbax checkpoint for
-``{params, model_state}`` plus a cloudpickled **predict-fn builder** — code +
-weights, restorable on any host (including CPU-only inference executors)
-without knowing the architecture in advance.
+carries only arrays, so the bundle format here is: gathered final weights
+plus a cloudpickled **predict-fn builder** — code + weights, restorable on
+any host (including CPU-only inference executors) without knowing the
+architecture in advance.
+
+Deliberately NOT orbax: training checkpoints (train/checkpoint.py) are
+collective and sharded — every process of a multi-host world participates —
+but an export bundle is the *serving* artifact, written by the chief alone
+from fully-gathered host arrays (the reference's chief-exports-SavedModel
+dance, compat.py:10-17). Using the collective path here would deadlock a
+chief-only export in a jax.distributed world.
 """
 
 import logging
@@ -17,7 +24,8 @@ import cloudpickle
 logger = logging.getLogger(__name__)
 
 _BUILDER_FILE = "predict_builder.pkl"
-_CKPT_DIR = "checkpoint"
+_WEIGHTS_FILE = "weights.pkl"
+_CKPT_DIR = "checkpoint"  # legacy orbax-format bundles (read-compat)
 
 
 def export_model(export_dir, predict_builder, params, model_state=None):
@@ -26,16 +34,24 @@ def export_model(export_dir, predict_builder, params, model_state=None):
     ``predict_builder`` is a picklable zero-arg callable returning
     ``predict_fn(params, model_state, batch_arrays) -> outputs`` (a dict of
     named arrays or a single array). It is invoked lazily at load time, so jax
-    is only imported in the serving process.
+    is only imported in the serving process. ``params``/``model_state`` may be
+    jax arrays (gathered to host here) or already-numpy trees.
     """
-    from tensorflowonspark_tpu.train import checkpoint
+    import numpy as np
 
     export_dir = os.path.abspath(os.path.expanduser(export_dir))
     os.makedirs(export_dir, exist_ok=True)
-    state = {"params": params}
-    if model_state is not None:
-        state["model_state"] = model_state
-    checkpoint.save_checkpoint(os.path.join(export_dir, _CKPT_DIR), state)
+    state = {"params": params, "model_state": model_state or {}}
+    try:  # gather device arrays; tolerate pure-numpy trees without jax
+        import jax
+
+        state = jax.tree.map(np.asarray, jax.device_get(state))
+    except ImportError:
+        pass
+    tmp = os.path.join(export_dir, _WEIGHTS_FILE + ".tmp")
+    with open(tmp, "wb") as f:
+        cloudpickle.dump(state, f)
+    os.replace(tmp, os.path.join(export_dir, _WEIGHTS_FILE))
     with open(os.path.join(export_dir, _BUILDER_FILE), "wb") as f:
         cloudpickle.dump(predict_builder, f)
     logger.info("exported model bundle to %s", export_dir)
@@ -44,12 +60,17 @@ def export_model(export_dir, predict_builder, params, model_state=None):
 
 def load_model(export_dir):
     """Load a bundle: returns ``(predict_fn, params, model_state)``."""
-    from tensorflowonspark_tpu.train import checkpoint
-
     export_dir = os.path.abspath(os.path.expanduser(export_dir))
     with open(os.path.join(export_dir, _BUILDER_FILE), "rb") as f:
         predict_builder = cloudpickle.load(f)
-    state = checkpoint.restore_checkpoint(os.path.join(export_dir, _CKPT_DIR))
+    weights = os.path.join(export_dir, _WEIGHTS_FILE)
+    if os.path.isfile(weights):
+        with open(weights, "rb") as f:
+            state = cloudpickle.load(f)
+    else:  # legacy orbax-format bundle
+        from tensorflowonspark_tpu.train import checkpoint
+
+        state = checkpoint.restore_checkpoint(os.path.join(export_dir, _CKPT_DIR))
     return predict_builder(), state["params"], state.get("model_state") or {}
 
 

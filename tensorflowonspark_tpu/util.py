@@ -108,6 +108,32 @@ def read_executor_state(cwd=None):
     return record
 
 
+def force_platform(platform, num_cpu_devices=None):
+    """Force the jax platform for THIS process, config-API-first.
+
+    Env vars alone are not enough on hosts whose site setup pre-imports jax
+    and pins a platform through ``jax.config`` (the config value wins over
+    ``JAX_PLATFORMS``) — e.g. TPU pods whose runtime registers the PJRT
+    plugin in every interpreter. Must run before the first jax backend use.
+    ``num_cpu_devices`` forces that many virtual CPU devices (test worlds).
+    """
+    os.environ["JAX_PLATFORMS"] = platform
+    if num_cpu_devices and platform == "cpu":
+        import re
+
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            os.environ.get("XLA_FLAGS", ""),
+        )
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count={}".format(int(num_cpu_devices))
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+
+
 def single_node_env(num_cpu_devices=None, platform=None):
     """Prepare the environment for a *single-node* jax process.
 

