@@ -1,0 +1,113 @@
+"""MNIST training from a STREAM of micro-batches (InputMode.SPARK).
+
+Parity with /root/reference/examples/mnist/estimator/mnist_spark_streaming.py
+(DStream feed :84-144): the reference used Spark Streaming +
+``ParameterServerStrategy`` for async training; on TPU there is no PS — the
+same capability is micro-batches flowing into the sync feed plane, with the
+training loop simply blocking in ``next_batch`` between waves. Stop either
+from the driver (``--num_waves`` exhausted → ``cluster.shutdown(ssc)``) or
+externally with ``examples/utils/stop_cluster.py <host> <port>`` (the
+reference's utils/stop_streaming.py analogue; the server address is printed
+at startup).
+
+Usage:
+    python examples/mnist/mnist_spark_streaming.py --cluster_size 2 \
+        --num_waves 5 --wave_rows 512 --platform cpu
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main_fun(args, ctx):
+    """Runs inside the jax child; trains for as long as micro-batches flow."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import parallel
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.train import SyncDataParallel
+
+    mesh = parallel.local_mesh({"dp": -1}) if ctx.num_processes == 1 else ctx.mesh({"dp": -1})
+    strategy = SyncDataParallel(mesh)
+    model = mnist.create_model("mlp")
+    optimizer = optax.adam(args.learning_rate)
+    state = strategy.create_state(mnist.make_init_fn(model), optimizer, jax.random.PRNGKey(0))
+    step = strategy.compile_train_step(mnist.make_loss_fn(model), optimizer, has_aux=True)
+
+    feed = ctx.get_data_feed(train_mode=True)
+    steps = 0
+    while not feed.should_stop():
+        # blocks while the stream is idle; returns when a batch fills or the
+        # shutdown end-of-feed marker arrives
+        batch = feed.next_batch(args.batch_size)
+        if not batch:
+            break
+        images = np.asarray([b[0] for b in batch], np.float32).reshape(-1, 28, 28)
+        labels = np.asarray([b[1] for b in batch])
+        state, metrics = step(state, strategy.shard_batch({"image": images, "label": labels}))
+        steps += 1
+        if steps % args.log_steps == 0:
+            print("streamed step {} loss {:.4f}".format(steps, float(metrics["loss"])))
+    print("stream ended after {} steps".format(steps))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=64)
+    parser.add_argument("--batch_interval", type=float, default=0.5)
+    parser.add_argument("--cluster_size", type=int, default=2)
+    parser.add_argument("--learning_rate", type=float, default=1e-3)
+    parser.add_argument("--log_steps", type=int, default=10)
+    parser.add_argument("--num_waves", type=int, default=5)
+    parser.add_argument("--wave_rows", type=int, default=512)
+    parser.add_argument("--platform", default=None)
+    args = parser.parse_args(argv)
+
+    from tensorflowonspark_tpu import TFCluster
+    from tensorflowonspark_tpu.backends.local import LocalSparkContext, LocalStreamingContext
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from mnist_data_setup import synthetic_mnist
+
+    sc = LocalSparkContext(num_executors=args.cluster_size)
+    ssc = LocalStreamingContext(sc, batch_interval=args.batch_interval)
+    env = {"JAX_PLATFORMS": args.platform} if args.platform else None
+    try:
+        cluster = TFCluster.run(
+            sc, main_fun, args, args.cluster_size,
+            input_mode=TFCluster.InputMode.SPARK, master_node="chief", env=env,
+        )
+        print("control plane at {}:{} (stop with examples/utils/stop_cluster.py)".format(
+            *cluster.cluster_meta["server_addr"]))
+        stream = ssc.queueStream()
+        cluster.train(stream)  # registers the micro-batch feed
+        ssc.start()
+
+        images, labels = synthetic_mnist(args.num_waves * args.wave_rows)
+        for wave in range(args.num_waves):
+            if cluster.stop_requested:
+                print("external stop request — ending stream")
+                break
+            lo = wave * args.wave_rows
+            rows = [
+                (images[i].ravel().tolist(), int(labels[i]))
+                for i in range(lo, lo + args.wave_rows)
+            ]
+            ssc.feed(sc.parallelize(rows, 2))
+            print("fed wave {}/{}".format(wave + 1, args.num_waves))
+            time.sleep(args.batch_interval)
+
+        cluster.shutdown(ssc=ssc, grace_secs=5)
+        print("streaming training complete")
+    finally:
+        sc.stop()
+
+
+if __name__ == "__main__":
+    main()

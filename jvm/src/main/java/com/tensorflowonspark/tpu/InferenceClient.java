@@ -1,0 +1,150 @@
+package com.tensorflowonspark.tpu;
+
+import java.io.Closeable;
+import java.io.DataInputStream;
+import java.io.DataOutputStream;
+import java.io.IOException;
+import java.net.Socket;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.List;
+
+/**
+ * Dependency-free client for the tensorflowonspark_tpu inference server
+ * (tensorflowonspark_tpu/serving.py) — the JVM half of the reference's
+ * Scala TFModel/Inference capability (batch inference driven from Spark
+ * executors), redesigned as host RPC because jax has no JNI runtime to
+ * embed in the executor JVM.
+ *
+ * Wire format: 4-byte big-endian length + UTF-8 JSON (see jvm/README.md).
+ * JSON is emitted/consumed with minimal hand-rolled code on the fixed
+ * message shapes so Spark jobs need no extra jars; swap in your JSON
+ * library via {@link #predictRaw(String)} if you have one.
+ *
+ * Typical Spark usage (one client per partition):
+ *
+ * <pre>
+ *   df.javaRDD().mapPartitions(rows -> {
+ *     InferenceClient c = new InferenceClient(host, port);
+ *     List&lt;double[]&gt; out = new ArrayList&lt;&gt;();
+ *     // batch rows, call c.predict("x", batch), collect outputs
+ *     c.close();
+ *     return out.iterator();
+ *   });
+ * </pre>
+ */
+public final class InferenceClient implements Closeable {
+
+  private final Socket socket;
+  private final DataInputStream in;
+  private final DataOutputStream out;
+
+  public InferenceClient(String host, int port) throws IOException {
+    this.socket = new Socket(host, port);
+    this.in = new DataInputStream(socket.getInputStream());
+    this.out = new DataOutputStream(socket.getOutputStream());
+  }
+
+  /** Round-trips one framed JSON message. */
+  private String request(String json) throws IOException {
+    byte[] payload = json.getBytes(StandardCharsets.UTF_8);
+    out.writeInt(payload.length);
+    out.write(payload);
+    out.flush();
+    int length = in.readInt();
+    if (length < 0 || length > (64 << 20)) {
+      throw new IOException("bad reply length " + length);
+    }
+    byte[] reply = new byte[length];
+    in.readFully(reply);
+    String text = new String(reply, StandardCharsets.UTF_8);
+    if (text.contains("\"type\": \"error\"") || text.contains("\"type\":\"error\"")) {
+      throw new IOException("server error: " + text);
+    }
+    return text;
+  }
+
+  public boolean ping() throws IOException {
+    return request("{\"type\": \"ping\"}").contains("pong");
+  }
+
+  public String info() throws IOException {
+    return request("{\"type\": \"info\"}");
+  }
+
+  /**
+   * Raw predict: {@code inputsJson} is the JSON object mapping column name
+   * to nested numeric lists; returns the raw outputs JSON object text.
+   */
+  public String predictRaw(String inputsJson) throws IOException {
+    String reply = request("{\"type\": \"predict\", \"inputs\": " + inputsJson + "}");
+    int i = reply.indexOf("\"outputs\"");
+    if (i < 0) {
+      throw new IOException("malformed reply: " + reply);
+    }
+    int start = reply.indexOf('{', i);
+    return reply.substring(start, reply.lastIndexOf('}'));
+  }
+
+  /**
+   * Predict on one 2-D input column; parses the first output's 2-D numeric
+   * array. For multi-column / multi-output models use {@link #predictRaw}.
+   */
+  public double[][] predict(String column, double[][] batch) throws IOException {
+    String outputs = predictRaw("{\"" + column + "\": " + toJson(batch) + "}");
+    int bracket = outputs.indexOf('[');
+    return parse2d(outputs.substring(bracket, matchBracket(outputs, bracket) + 1));
+  }
+
+  @Override
+  public void close() throws IOException {
+    socket.close();
+  }
+
+  // -- minimal JSON helpers for the fixed shapes ---------------------------
+
+  public static String toJson(double[][] rows) {
+    StringBuilder sb = new StringBuilder("[");
+    for (int r = 0; r < rows.length; r++) {
+      if (r > 0) sb.append(',');
+      sb.append('[');
+      for (int c = 0; c < rows[r].length; c++) {
+        if (c > 0) sb.append(',');
+        sb.append(rows[r][c]);
+      }
+      sb.append(']');
+    }
+    return sb.append(']').toString();
+  }
+
+  static int matchBracket(String s, int open) {
+    int depth = 0;
+    for (int i = open; i < s.length(); i++) {
+      char ch = s.charAt(i);
+      if (ch == '[') depth++;
+      if (ch == ']' && --depth == 0) return i;
+    }
+    throw new IllegalArgumentException("unbalanced brackets");
+  }
+
+  static double[][] parse2d(String json) {
+    List<double[]> rows = new ArrayList<>();
+    int i = json.indexOf('[', 1);
+    while (i >= 0) {
+      int end = json.indexOf(']', i);
+      String inner = json.substring(i + 1, end).trim();
+      if (inner.isEmpty()) {
+        rows.add(new double[0]);
+      } else {
+        String[] parts = inner.split(",");
+        double[] row = new double[parts.length];
+        for (int j = 0; j < parts.length; j++) {
+          row[j] = Double.parseDouble(parts[j].trim());
+        }
+        rows.add(row);
+      }
+      i = json.indexOf('[', end);
+    }
+    return rows.toArray(new double[0][]);
+  }
+}

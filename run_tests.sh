@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# One-shot test harness (the reference's test/run_tests.sh analogue, which
+# booted a 2-worker local Spark Standalone cluster around unittest discover).
+#
+# Without pyspark: the suite runs against the bundled local multi-process
+# backend (the Spark stand-in; same executor-process semantics).
+# With pyspark installed: additionally boots a local-cluster master so the
+# integration tests can target real Spark executors.
+#
+# Usage: ./run_tests.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")"
+
+export JAX_PLATFORMS=cpu
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+
+if python -c "import pyspark" 2>/dev/null; then
+  echo "pyspark available: running with TOS_TEST_PYSPARK=1 (local-cluster[2,1,1024])"
+  export TOS_TEST_PYSPARK=1
+  export MASTER="local-cluster[2,1,1024]"
+else
+  echo "pyspark not installed: using the bundled local multi-process backend"
+fi
+
+exec python -m pytest tests/ -q "$@"

@@ -51,12 +51,48 @@ class TFCluster:
     # -- data plane -----------------------------------------------------------
 
     def train(self, dataRDD, num_epochs=0, feed_timeout=600, qname="input"):
-        """Feed an RDD to the cluster for training (InputMode.SPARK only);
-        blocks until the data is consumed or training requests a stop
-        (reference TFCluster.py:63-94)."""
-        logger.info("feeding training data (epochs=%s)", num_epochs)
+        """Feed data to the cluster for training (InputMode.SPARK only).
+
+        ``dataRDD`` may be (reference TFCluster.py:63-94):
+
+        * an RDD — fed for ``num_epochs`` epochs; blocks until consumed or
+          training requests a stop;
+        * a DStream (anything with ``foreachRDD``) — every micro-batch is fed
+          as it arrives; returns immediately (the streaming context drives
+          the feeding; stop via ``shutdown(ssc)`` or a STOP on the control
+          plane, reference TFCluster.py:83-85);
+        * an iterable/generator of RDDs — micro-batches fed sequentially
+          until exhausted or :attr:`stop_requested`.
+        """
         assert self.input_mode == InputMode.SPARK, "train() requires InputMode.SPARK"
         assert dataRDD is not None, "dataRDD is required"
+        task = TFSparkNode.train(
+            self.cluster_info, self.cluster_meta, feed_timeout=feed_timeout, qname=qname
+        )
+
+        if hasattr(dataRDD, "foreachRDD"):  # DStream-equivalent
+            logger.info("feeding training data from a stream (micro-batches)")
+
+            # exactly ONE positional arg: pyspark's foreachRDD inspects
+            # co_argcount and passes (batch_time, rdd) to 2-arg functions —
+            # and defaulted params count, so `task` must be a closure
+            def _feed_micro_batch(rdd):
+                if not self.stop_requested:
+                    rdd.foreachPartition(task)
+
+            dataRDD.foreachRDD(_feed_micro_batch)
+            return
+
+        if not hasattr(dataRDD, "foreachPartition"):  # iterable of RDDs
+            logger.info("feeding training data from an RDD iterator")
+            for rdd in dataRDD:
+                if self.stop_requested:
+                    logger.info("stop requested; ending stream feed")
+                    break
+                rdd.foreachPartition(task)
+            return
+
+        logger.info("feeding training data (epochs=%s)", num_epochs)
         assert num_epochs is None or num_epochs >= 0, "num_epochs cannot be negative"
         if not num_epochs:
             # unspecified: feed "many" epochs and rely on the training loop to
@@ -66,9 +102,7 @@ class TFCluster:
         rdd = dataRDD
         if num_epochs > 1:
             rdd = self.sc.union([dataRDD] * num_epochs)
-        rdd.foreachPartition(
-            TFSparkNode.train(self.cluster_info, self.cluster_meta, feed_timeout=feed_timeout, qname=qname)
-        )
+        rdd.foreachPartition(task)
 
     def inference(self, dataRDD, feed_timeout=600, qname="input", qname_out="output"):
         """Feed an RDD for inference; returns a (lazy) RDD of results with a
@@ -84,13 +118,29 @@ class TFCluster:
 
     # -- teardown -------------------------------------------------------------
 
+    @property
+    def stop_requested(self):
+        """True once any node (or an external tool like utils/stop_cluster)
+        sent STOP on the control plane — streaming feeds poll this."""
+        return self.server.stop_requested
+
     def shutdown(self, ssc=None, grace_secs=0, timeout=259200):
         """Stop the cluster: end-of-feed to every worker, wait for the launch
         job, stop driver-managed roles, surface any node error
         (reference TFCluster.py:117-202; the 3-day default timeout mirrors
-        its SIGALRM watchdog, TFCluster.py:136-144)."""
+        its SIGALRM watchdog, TFCluster.py:136-144).
+
+        ``ssc``: a streaming context feeding this cluster — stopped
+        gracefully first so queued micro-batches drain before the end-of-feed
+        markers go out (reference streaming-aware shutdown,
+        mnist_spark_streaming.py:141-144).
+        """
         logger.info("shutting down cluster")
-        del ssc  # streaming handled at a higher layer
+        if ssc is not None:
+            try:
+                ssc.stop(stopSparkContext=False, stopGraceFully=True)
+            except TypeError:  # non-pyspark signature
+                ssc.stop()
 
         try:
             if self.input_mode == InputMode.SPARK:
@@ -200,6 +250,19 @@ def build_cluster_template(num_executors, num_ps=0, master_node="chief", eval_no
     return template
 
 
+def resolve_default_fs(sc):
+    """Default filesystem for the cluster: the local backend exposes
+    ``defaultFS`` directly; real pyspark answers through the JVM Hadoop conf
+    (reference TFCluster.py:271-274)."""
+    default_fs = getattr(sc, "defaultFS", None)
+    if default_fs is None:
+        try:  # real pyspark: ask the Hadoop conf
+            default_fs = sc._jsc.hadoopConfiguration().get("fs.defaultFS")
+        except Exception:
+            default_fs = "file://"
+    return default_fs
+
+
 def run(
     sc,
     map_fun,
@@ -244,12 +307,7 @@ def run(
     server = reservation.Server(num_executors)
     server_addr = server.start()
 
-    default_fs = getattr(sc, "defaultFS", None)
-    if default_fs is None:
-        try:  # real pyspark: ask the Hadoop conf
-            default_fs = sc._jsc.hadoopConfiguration().get("fs.defaultFS")
-        except Exception:
-            default_fs = "file://"
+    default_fs = resolve_default_fs(sc)
 
     cluster_meta = {
         "id": random.getrandbits(64),

@@ -26,6 +26,7 @@ import queue
 import shutil
 import tempfile
 import threading
+import time
 import traceback
 import uuid
 
@@ -213,6 +214,89 @@ class LocalDataFrame:
 
     def count(self):
         return self._rdd.count()
+
+
+class LocalDStream:
+    """Micro-batch stream handle (the ``pyspark.streaming.DStream`` surface
+    the framework uses: ``foreachRDD``)."""
+
+    def __init__(self, ssc):
+        self._ssc = ssc
+        self._handlers = []
+
+    def foreachRDD(self, fn):
+        self._handlers.append(fn)
+        return self
+
+
+class LocalStreamingContext:
+    """DStream-equivalent micro-batch driver — the ``StreamingContext``
+    stand-in for single-host deployments and tests (the reference fed
+    training from Spark Streaming DStreams,
+    /root/reference/tensorflowonspark/TFCluster.py:83-85 and
+    examples/mnist/estimator/mnist_spark_streaming.py).
+
+    ``queueStream`` mirrors pyspark's: one queued RDD is consumed per batch
+    interval; ``feed`` pushes further micro-batches while running.
+    """
+
+    def __init__(self, sc, batch_interval=1.0):
+        self.sc = sc
+        self.batch_interval = batch_interval
+        self._queue = queue.Queue()
+        self._streams = []
+        self._stop_ev = threading.Event()
+        self._thread = None
+        self._busy = threading.Lock()  # held while a micro-batch is feeding
+
+    def queueStream(self, rdds=None):
+        stream = LocalDStream(self)
+        self._streams.append(stream)
+        for rdd in rdds or []:
+            self._queue.put(rdd)
+        return stream
+
+    def feed(self, rdd):
+        """Push one more micro-batch into the stream."""
+        self._queue.put(rdd)
+
+    def start(self):
+        def _run():
+            while not self._stop_ev.is_set():
+                try:
+                    rdd = self._queue.get(timeout=self.batch_interval)
+                except queue.Empty:
+                    continue
+                with self._busy:
+                    for stream in self._streams:
+                        for handler in stream._handlers:
+                            try:
+                                handler(rdd)
+                            except Exception:
+                                logger.exception("streaming micro-batch handler failed")
+
+        self._thread = threading.Thread(target=_run, name="tos-streaming", daemon=True)
+        self._thread.start()
+
+    def stop(self, stopSparkContext=False, stopGraceFully=True):
+        if stopGraceFully:
+            # drain queued micro-batches AND wait out the in-flight handler —
+            # queue emptiness alone would let shutdown's end-of-feed markers
+            # cut off a batch that was dequeued but not yet fully fed
+            deadline = time.time() + 60
+            while not self._queue.empty() and time.time() < deadline:
+                time.sleep(0.1)
+            with self._busy:
+                pass
+        self._stop_ev.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        if stopSparkContext:
+            self.sc.stop()
+
+    def awaitTermination(self, timeout=None):
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
 
 
 class LocalSparkContext:

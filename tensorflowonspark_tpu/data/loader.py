@@ -10,6 +10,7 @@ of the device so the MXU never waits on the host.
 """
 
 import logging
+import os
 import queue
 import threading
 
@@ -31,10 +32,13 @@ def shard_files(files, num_shards, index):
 
 
 def _read_shard(path, verify_crc=True):
-    """All raw records of one shard; native bulk reader when available."""
+    """All raw records of one shard; native bulk reader for local files
+    (file:// included), fsspec-routed Python codec for remote URIs."""
     from tensorflowonspark_tpu import native_io, tfrecord
 
-    if native_io.available():
+    if path.startswith("file://"):
+        path = path[len("file://"):]
+    if not tfrecord.is_uri(path) and native_io.available():
         return native_io.read_records(path, verify_crc=verify_crc)
     return list(tfrecord.read_records(path, verify_crc=verify_crc))
 
@@ -57,7 +61,7 @@ class ImagePipeline:
         batch_size,
         shuffle=True,
         seed=0,
-        num_threads=8,
+        num_threads=None,
         epochs=1,
         prefetch_batches=2,
         verify_crc=False,
@@ -69,7 +73,9 @@ class ImagePipeline:
         self.batch_size = int(batch_size)
         self.shuffle = shuffle
         self.seed = seed
-        self.num_threads = num_threads
+        # default threads from TOS_DATA_THREADS — the ML pipeline's `readers`
+        # param lands here (reference HasReaders controlled enqueue threads)
+        self.num_threads = num_threads or int(os.environ.get("TOS_DATA_THREADS", "8"))
         self.epochs = epochs
         self.prefetch_batches = prefetch_batches
         self.verify_crc = verify_crc

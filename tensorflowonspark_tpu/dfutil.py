@@ -96,12 +96,12 @@ def saveAsTFRecords(df, output_dir, binary_features=()):
     """Write a DataFrame as TFRecord shards, one per partition
     (reference dfutil.py:29-41)."""
     columns = list(df.columns)
-    output_dir = os.path.abspath(os.path.expanduser(output_dir))
-    os.makedirs(output_dir, exist_ok=True)
+    if not tfrecord.is_uri(output_dir):
+        output_dir = os.path.abspath(os.path.expanduser(output_dir))
+    tfrecord.makedirs(output_dir)
     bin_feats = tuple(binary_features)
 
     def _write_partition(pidx, it):
-        import os as _os
         import uuid as _uuid
 
         examples = [toTFExample(row, columns, bin_feats) for row in it]
@@ -111,10 +111,10 @@ def saveAsTFRecords(df, output_dir, binary_features=()):
         # to a temp name, then atomically rename onto the deterministic
         # per-partition name — task retries/speculative duplicates overwrite
         # instead of duplicating records
-        final = _os.path.join(output_dir, "part-r-{:05d}".format(pidx))
+        final = "{}/part-r-{:05d}".format(output_dir.rstrip("/"), pidx)
         tmp = final + "." + _uuid.uuid4().hex[:8] + ".tmp"
         n = tfrecord.write_shard(tmp, examples)
-        _os.replace(tmp, final)
+        tfrecord.rename(tmp, final)
         return [n]
 
     rdd = df.rdd
@@ -127,7 +127,8 @@ def loadTFRecords(sc, input_dir, binary_features=(), columns=None):
     """Read TFRecord shards back into a DataFrame (reference dfutil.py:44-81):
     schema inferred from the first record, provenance recorded in
     ``loadedDF``."""
-    input_dir = os.path.abspath(os.path.expanduser(input_dir))
+    if not tfrecord.is_uri(input_dir):
+        input_dir = os.path.abspath(os.path.expanduser(input_dir))
     shards = tfrecord.list_shards(input_dir)
     if not shards:
         raise FileNotFoundError("no TFRecord shards under {}".format(input_dir))

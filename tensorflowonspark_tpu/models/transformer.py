@@ -199,7 +199,11 @@ _TP_RULES = (
     (r"attn/o/kernel$", ("tp", None, "fsdp")),  # [H, head_dim, d_model]
     (r"mlp/wi/kernel$", ("fsdp", "tp")),  # [d_model, d_ff]
     (r"mlp/wo/kernel$", ("tp", "fsdp")),  # [d_ff, d_model]
-    (r"embed/embedding$", (None, "fsdp")),  # [vocab, d_model]
+    # vocab-parallel (Megatron-style): sharding d_model here instead forces
+    # XLA to fully rematerialize the gather output to reach the activations'
+    # P(batch, seq, None) layout (the round-1 dryrun's SPMD warning); with
+    # the vocab dim sharded the gather lowers to masked-lookup + psum
+    (r"embed/embedding$", ("fsdp", None)),  # [vocab, d_model]
     (r"lm_head/kernel$", ("fsdp", "tp")),  # [d_model, vocab]
 )
 

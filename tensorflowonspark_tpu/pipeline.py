@@ -251,7 +251,12 @@ class HasOutputMapping(Params):
 
 
 class HasProtocol(Params):
-    protocol = Param("protocol", "fabric selection: 'ici' | 'dcn' (reference: grpc/rdma)", str)
+    protocol = Param(
+        "protocol",
+        "fabric selection: 'ici' (single slice; default) | 'dcn' (cross-host/"
+        "slice: forces the jax.distributed world on). Reference: grpc/rdma",
+        str,
+    )
 
     def __init__(self):
         super().__init__()
@@ -265,7 +270,12 @@ class HasProtocol(Params):
 
 
 class HasReaders(Params):
-    readers = Param("readers", "number of reader/enqueue threads", int)
+    readers = Param(
+        "readers",
+        "input-pipeline reader/parse threads per node (lands in the jax "
+        "children as TOS_DATA_THREADS, the data.ImagePipeline default)",
+        int,
+    )
 
     def __init__(self):
         super().__init__()
@@ -441,11 +451,23 @@ class TFEstimator(TFParams, HasBatchSize, HasClusterSize, HasEpochs, HasGraceSec
         if sc is None:
             sc = rdd.context  # real pyspark
 
+        env = dict(self.env or {})
+        if getattr(args, "readers", 0):
+            # `readers` → input-pipeline thread count in the jax children
+            # (tensorflowonspark_tpu.data.ImagePipeline default; reference
+            # HasReaders controlled the enqueue-thread count)
+            env.setdefault("TOS_DATA_THREADS", str(args.readers))
+        jax_distributed = self.jax_distributed
+        if jax_distributed is None and getattr(args, "protocol", "ici") == "dcn":
+            # 'dcn' = the cluster spans hosts/slices: the cross-process
+            # jax.distributed world is mandatory (reference: protocol chose
+            # the grpc vs grpc+verbs transport, TFNode.py:126-129)
+            jax_distributed = True
         cluster = TFCluster.run(
             sc, self.train_fn, args, args.cluster_size, num_ps=args.num_ps,
             tensorboard=args.tensorboard, input_mode=TFCluster.InputMode.SPARK,
             master_node=args.master_node, driver_ps_nodes=args.driver_ps_nodes,
-            env=self.env, jax_distributed=self.jax_distributed,
+            env=env or None, jax_distributed=jax_distributed,
         )
         cluster.train(dataset.select(input_cols).rdd, args.epochs)
         cluster.shutdown(grace_secs=args.grace_secs)

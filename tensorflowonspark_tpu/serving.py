@@ -1,0 +1,185 @@
+"""Model-bundle inference server — the JVM-inference equivalent.
+
+The reference shipped a Scala/JNI stack so JVM Spark jobs could run batch
+inference without Python (/root/reference/src/main/scala/com/yahoo/
+tensorflowonspark/Inference.scala:17, TFModel.scala:38 — SavedModelBundle via
+libtensorflow). A jax model has no JNI runtime to embed, so the TPU-native
+equivalent is a host RPC: this server owns the model bundle (and the TPU
+chips) in a Python process, and any JVM executor talks to it over a tiny
+length-prefixed JSON protocol (``jvm/`` ships a dependency-free Java client
+for Spark mapPartitions; the wire format is specified in jvm/README.md).
+
+Protocol (4-byte big-endian length + UTF-8 JSON, same framing as the
+reservation control plane):
+
+* ``{"type": "ping"}`` → ``{"type": "pong"}``
+* ``{"type": "info"}`` → ``{"type": "info", "export_dir": ..., "ready": true}``
+* ``{"type": "predict", "inputs": {name: nested-lists, ...}}`` →
+  ``{"type": "result", "outputs": {name: nested-lists, ...}}``
+* anything else / failure → ``{"type": "error", "message": ...}``
+
+Start standalone:  ``python -m tensorflowonspark_tpu.serving --export_dir
+/path/bundle --port 8500``
+"""
+
+import argparse
+import json
+import logging
+import socket
+import threading
+
+from tensorflowonspark_tpu.reservation import MessageSocket
+
+logger = logging.getLogger(__name__)
+
+
+class InferenceServer:
+    """Serve one exported model bundle over TCP (thread per connection)."""
+
+    def __init__(self, export_dir, host="", port=0):
+        from tensorflowonspark_tpu.train import export
+
+        self.export_dir = export_dir
+        predict_fn, params, model_state = export.load_model(export_dir)
+        self._predict_fn = predict_fn
+        self._params = params
+        self._model_state = model_state
+        self._lock = threading.Lock()  # predictions serialized onto the chips
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.address = self._sock.getsockname()
+        self._shutdown = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._serve, name="tos-serving", daemon=True)
+        self._thread.start()
+        logger.info("inference server for %s at %s", self.export_dir, self.address)
+        return self.address
+
+    def stop(self):
+        self._shutdown.set()
+        try:
+            with socket.create_connection(("127.0.0.1", self.address[1]), timeout=1):
+                pass
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- internals ------------------------------------------------------------
+
+    def _serve(self):
+        while not self._shutdown.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return
+            if self._shutdown.is_set():
+                conn.close()
+                return
+            threading.Thread(
+                target=self._handle_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _handle_conn(self, conn):
+        msock = MessageSocket(conn)
+        try:
+            while True:
+                try:
+                    msg = msock.recv()
+                except (OSError, ValueError):
+                    return
+                if msg is None:
+                    return
+                try:
+                    msock.send(self._handle(msg))
+                except OSError:
+                    return
+        finally:
+            msock.close()
+
+    def _handle(self, msg):
+        kind = msg.get("type") if isinstance(msg, dict) else None
+        if kind == "ping":
+            return {"type": "pong"}
+        if kind == "info":
+            return {"type": "info", "export_dir": self.export_dir, "ready": True}
+        if kind == "predict":
+            try:
+                return {"type": "result", "outputs": self._predict(msg.get("inputs") or {})}
+            except Exception as e:
+                logger.exception("predict failed")
+                return {"type": "error", "message": "{}: {}".format(type(e).__name__, e)}
+        return {"type": "error", "message": "unknown message type {!r}".format(kind)}
+
+    def _predict(self, inputs):
+        import numpy as np
+
+        arrays = {name: np.asarray(vals) for name, vals in inputs.items()}
+        with self._lock:
+            outputs = self._predict_fn(self._params, self._model_state, arrays)
+        if not isinstance(outputs, dict):
+            outputs = {"output": outputs}
+        return {name: np.asarray(v).tolist() for name, v in outputs.items()}
+
+
+class InferenceClient:
+    """Python twin of the JVM client (jvm/.../InferenceClient.java)."""
+
+    def __init__(self, address, timeout=120):
+        self.address = (address[0], int(address[1]))
+        self.timeout = timeout
+        self._sock = socket.create_connection(self.address, timeout=timeout)
+        self._msock = MessageSocket(self._sock)
+
+    def _request(self, msg):
+        self._msock.send(msg)
+        reply = self._msock.recv()
+        if reply is None:
+            raise ConnectionError("inference server closed the connection")
+        if reply.get("type") == "error":
+            raise RuntimeError(reply.get("message"))
+        return reply
+
+    def ping(self):
+        return self._request({"type": "ping"})["type"] == "pong"
+
+    def info(self):
+        return self._request({"type": "info"})
+
+    def predict(self, **inputs):
+        """Column name → nested lists / numpy arrays; returns dict of lists."""
+        inputs = {
+            k: v.tolist() if hasattr(v, "tolist") else v for k, v in inputs.items()
+        }
+        return self._request({"type": "predict", "inputs": inputs})["outputs"]
+
+    def close(self):
+        self._msock.close()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--export_dir", required=True)
+    parser.add_argument("--host", default="")
+    parser.add_argument("--port", type=int, default=8500)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = InferenceServer(args.export_dir, args.host, args.port)
+    host, port = server.start()
+    print(json.dumps({"serving": args.export_dir, "host": host or "0.0.0.0", "port": port}), flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -6,8 +6,17 @@ dfutil.py:39,63) and the Example proto to TensorFlow. Here both are
 implemented directly: the TFRecord framing (length + masked-crc32c records)
 and a minimal protobuf codec for the fixed ``Example`` schema — so the TPU
 framework reads/writes the interchange format without a TensorFlow or JVM
-dependency. (A C++ reader for the bulk-ingest hot path lives in
-``native/``.)
+dependency.
+
+The bulk-ingest hot path has a C++ twin in ``native/tfrecord_io.cc`` (one
+FFI call loads+verifies a whole shard), bound via
+:mod:`tensorflowonspark_tpu.native_io`; this module is the portable codec
+and the write path.
+
+Remote filesystems: paths with a URI scheme (``gs://``, ``hdfs://``,
+``s3://``, ``memory://``, ``file://``) are routed through fsspec — the
+replacement for the reference's Hadoop-FS-by-way-of-the-jar reach
+(reference dfutil.py:39-41,63-65).
 
 Wire format reference: tensorflow/core/lib/io/record_writer.h (framing) and
 tensorflow/core/example/example.proto, feature.proto (schema).
@@ -17,6 +26,45 @@ import os
 import struct
 
 import google_crc32c
+
+# -- filesystem routing (local fast path; fsspec for URI schemes) -------------
+
+
+def is_uri(path):
+    return "://" in str(path)
+
+
+def _fs(path):
+    import fsspec
+
+    fs, _token, paths = fsspec.get_fs_token_paths(path)
+    return fs, paths[0]
+
+
+def open_file(path, mode="rb"):
+    """Open a local path or any fsspec URI."""
+    if is_uri(path):
+        fs, p = _fs(path)
+        return fs.open(p, mode)
+    return open(path, mode)
+
+
+def makedirs(path):
+    if is_uri(path):
+        fs, p = _fs(path)
+        fs.makedirs(p, exist_ok=True)
+    else:
+        os.makedirs(path, exist_ok=True)
+
+
+def rename(src, dst):
+    """Atomic-ish same-filesystem rename (the shard commit step)."""
+    if is_uri(src):
+        fs, s = _fs(src)
+        _fs2, d = _fs(dst)
+        fs.mv(s, d)
+    else:
+        os.replace(src, dst)
 
 # -- TFRecord framing ----------------------------------------------------------
 
@@ -30,7 +78,7 @@ def _masked_crc(data):
 
 class TFRecordWriter:
     def __init__(self, path):
-        self._f = open(path, "wb")
+        self._f = open_file(path, "wb")
 
     def write(self, record):
         header = struct.pack("<Q", len(record))
@@ -50,8 +98,8 @@ class TFRecordWriter:
 
 
 def read_records(path, verify_crc=True):
-    """Yield raw record bytes from a TFRecord file."""
-    with open(path, "rb") as f:
+    """Yield raw record bytes from a TFRecord file (local or fsspec URI)."""
+    with open_file(path, "rb") as f:
         while True:
             header = f.read(8)
             if not header:
@@ -244,7 +292,8 @@ def decode_example(buf):
 
 def write_shard(path, examples):
     """Write a list of feature-dicts as one TFRecord shard file."""
-    os.makedirs(os.path.dirname(path), exist_ok=True)
+    parent = path.rsplit("/", 1)[0] if is_uri(path) else os.path.dirname(path)
+    makedirs(parent)
     count = 0
     with TFRecordWriter(path) as w:
         for features in examples:
@@ -253,11 +302,23 @@ def write_shard(path, examples):
     return count
 
 
+def _is_shard_name(name):
+    return name.startswith(("part-", "shard-")) and not name.endswith((".crc", ".tmp"))
+
+
 def list_shards(directory):
-    """TFRecord shard files under a directory (reference part-r-* layout)."""
+    """TFRecord shard files under a directory (reference part-r-* layout);
+    accepts local paths and fsspec URIs."""
+    if is_uri(directory):
+        fs, p = _fs(directory)
+        out = []
+        for entry in sorted(fs.ls(p, detail=False)):
+            if _is_shard_name(entry.rsplit("/", 1)[-1]):
+                out.append(fs.unstrip_protocol(entry))
+        return out
     out = []
     for name in sorted(os.listdir(directory)):
-        if name.startswith(("part-", "shard-")) and not name.endswith((".crc", ".tmp")):
+        if _is_shard_name(name):
             out.append(os.path.join(directory, name))
     return out
 

@@ -46,6 +46,15 @@ def test_mnist_spark_mode(tmp_path):
     assert os.path.isdir(export_dir)
 
 
+def test_mnist_streaming(tmp_path):
+    out = _run(
+        "mnist/mnist_spark_streaming.py", "--cluster_size", "1",
+        "--num_waves", "3", "--wave_rows", "128", "--batch_size", "32",
+        "--platform", "cpu",
+    )
+    assert "streaming training complete" in out
+
+
 def test_segmentation_spark(tmp_path):
     out = _run(
         "segmentation/segmentation_spark.py", "--cluster_size", "1",

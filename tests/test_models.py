@@ -237,4 +237,6 @@ class TestTransformer:
 
         assert specs["layer_0"]["attn"]["q"]["kernel"] == P("fsdp", "tp", None)
         assert specs["layer_0"]["mlp"]["wo"]["kernel"] == P("tp", "fsdp")
-        assert specs["embed"]["embedding"] == P(None, "fsdp")
+        # vocab-parallel embedding: d_model stays replicated so the gather
+        # output lands directly in the activations' layout (no SPMD remat)
+        assert specs["embed"]["embedding"] == P("fsdp", None)

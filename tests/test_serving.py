@@ -1,0 +1,97 @@
+"""Inference server (the JVM-inference equivalent) — VERDICT round-1 item 10.
+
+The byte-level test speaks the wire protocol with raw sockets, framing
+messages exactly as jvm/.../InferenceClient.java does (4-byte big-endian
+length + UTF-8 JSON), so the JVM contract is pinned without a JVM in the
+image. Reference analogue: Scala Inference.scala/TFModel.scala batch
+inference from Spark executors.
+"""
+
+import json
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.serving import InferenceClient, InferenceServer
+from tensorflowonspark_tpu.train import export
+
+
+def _bundle(tmp_path):
+    """A linear y = x @ w + b bundle, like the pipeline's export."""
+    w = np.array([[2.0], [3.0]], np.float32)
+    b = np.array([1.0], np.float32)
+
+    def predict_builder():
+        def predict(params, model_state, arrays):
+            return {"y_": arrays["x"] @ params["w"] + params["b"]}
+
+        return predict
+
+    path = str(tmp_path / "bundle")
+    export.export_model(path, predict_builder, {"w": w, "b": b})
+    return path
+
+
+@pytest.fixture
+def server(tmp_path):
+    srv = InferenceServer(_bundle(tmp_path))
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _jvm_style_request(address, payload_text):
+    """Frame and send exactly like the Java client: writeInt + UTF-8 bytes."""
+    with socket.create_connection(address, timeout=30) as sock:
+        data = payload_text.encode("utf-8")
+        sock.sendall(struct.pack(">I", len(data)) + data)
+        header = b""
+        while len(header) < 4:
+            header += sock.recv(4 - len(header))
+        (length,) = struct.unpack(">I", header)
+        body = b""
+        while len(body) < length:
+            body += sock.recv(length - len(body))
+        return json.loads(body.decode("utf-8"))
+
+
+def test_raw_socket_protocol(server):
+    assert _jvm_style_request(server.address, '{"type": "ping"}') == {"type": "pong"}
+    info = _jvm_style_request(server.address, '{"type": "info"}')
+    assert info["ready"] is True
+
+    reply = _jvm_style_request(
+        server.address,
+        '{"type": "predict", "inputs": {"x": [[1.0, 1.0], [0.0, 2.0]]}}',
+    )
+    assert reply["type"] == "result"
+    np.testing.assert_allclose(reply["outputs"]["y_"], [[6.0], [7.0]])
+
+
+def test_error_reply_for_unknown_type(server):
+    reply = _jvm_style_request(server.address, '{"type": "wat"}')
+    assert reply["type"] == "error"
+
+
+def test_python_client_roundtrip(server):
+    client = InferenceClient(server.address)
+    try:
+        assert client.ping()
+        out = client.predict(x=np.array([[1.0, 2.0], [3.0, 0.5]], np.float32))
+        np.testing.assert_allclose(out["y_"], [[9.0], [8.5]])
+        # persistent connection: a second request on the same socket
+        out2 = client.predict(x=[[0.0, 0.0]])
+        np.testing.assert_allclose(out2["y_"], [[1.0]])
+    finally:
+        client.close()
+
+
+def test_predict_failure_surfaces(server):
+    client = InferenceClient(server.address)
+    try:
+        with pytest.raises(RuntimeError):
+            client.predict(wrong_column=[[1.0]])
+    finally:
+        client.close()
