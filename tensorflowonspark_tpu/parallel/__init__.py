@@ -17,6 +17,7 @@ Canonical mesh axes (any subset may be present, always in this order):
 ``tp``   tensor (a.k.a. model) parallelism — activations/weights sharded
 ``sp``   sequence/context parallelism — ring attention over this axis
 ``ep``   expert parallelism for MoE layers
+``pp``   pipeline parallelism — GPipe stages (pipeline_parallel module)
 =======  =====================================================================
 """
 
@@ -38,6 +39,10 @@ _EXPORTS = {
     "collectives": None,
     "ring_attention": "ring_attention",
     "ring_attention_sharded": "ring_attention",
+    "pipeline_apply": "pipeline_parallel",
+    "stack_stage_params": "pipeline_parallel",
+    "split_microbatches": "pipeline_parallel",
+    "merge_microbatches": "pipeline_parallel",
 }
 
 

@@ -15,7 +15,7 @@ logger = logging.getLogger(__name__)
 
 #: canonical axis order; meshes are always built with axes in this order so
 #: collectives ride ICI for the innermost (fastest-varying) axes.
-AXIS_ORDER = ("dp", "fsdp", "tp", "sp", "ep")
+AXIS_ORDER = ("dp", "fsdp", "tp", "sp", "ep", "pp")
 
 
 def _normalize_axes(axes, num_devices):

@@ -157,3 +157,85 @@ class TestRingAttention:
         g_plain = jax.grad(loss_plain, argnums=(0, 1, 2))(q, k, v)
         for gr, gp in zip(g_ring, g_plain):
             np.testing.assert_allclose(np.asarray(gr), np.asarray(gp), atol=1e-4)
+
+
+class TestPipelineParallel:
+    """GPipe over the ``pp`` axis (beyond-parity; SURVEY §2.7 row PP):
+    pipelined forward/backward must equal the sequential stage composition."""
+
+    def _setup(self):
+        import numpy as np
+
+        from tensorflowonspark_tpu import parallel
+
+        mesh = parallel.build_mesh({"pp": 4}, devices=jax.devices()[:4])
+        rng = np.random.default_rng(0)
+        d = 8
+        stage_weights = [
+            jnp.asarray(rng.standard_normal((d, d)) / np.sqrt(d), jnp.float32)
+            for _ in range(4)
+        ]
+        stacked = parallel.stack_stage_params(
+            [{"w": w} for w in stage_weights]
+        )
+        x = jnp.asarray(rng.standard_normal((16, d)), jnp.float32)
+        return parallel, mesh, stage_weights, stacked, x
+
+    @staticmethod
+    def _stage_fn(params, x):
+        return jnp.tanh(x @ params["w"])
+
+    def _sequential(self, stage_weights, x):
+        for w in stage_weights:
+            x = self._stage_fn({"w": w}, x)
+        return x
+
+    def test_forward_matches_sequential(self):
+        import numpy as np
+
+        parallel, mesh, weights, stacked, x = self._setup()
+        mb = parallel.split_microbatches(x, 8)
+        out = parallel.pipeline_apply(self._stage_fn, stacked, mb, mesh)
+        got = parallel.merge_microbatches(out)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(self._sequential(weights, x)), atol=1e-6
+        )
+
+    def test_gradients_match_sequential(self):
+        import numpy as np
+
+        parallel, mesh, weights, stacked, x = self._setup()
+        mb = parallel.split_microbatches(x, 8)
+
+        def loss_pp(stacked_params):
+            out = parallel.pipeline_apply(self._stage_fn, stacked_params, mb, mesh)
+            return jnp.sum(out ** 2)
+
+        def loss_seq(stacked_params):
+            y = x
+            for i in range(4):
+                y = self._stage_fn(jax.tree.map(lambda a: a[i], stacked_params), y)
+            return jnp.sum(y ** 2)
+
+        g_pp = jax.grad(loss_pp)(stacked)
+        g_seq = jax.grad(loss_seq)(stacked)
+        np.testing.assert_allclose(
+            np.asarray(g_pp["w"]), np.asarray(g_seq["w"]), atol=1e-5
+        )
+
+    def test_jit_with_sharded_stage_params(self):
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        parallel, mesh, weights, stacked, x = self._setup()
+        stacked = jax.device_put(stacked, NamedSharding(mesh, P("pp")))
+        mb = parallel.split_microbatches(x, 8)
+
+        @jax.jit
+        def run(params, mb):
+            return parallel.pipeline_apply(self._stage_fn, params, mb, mesh)
+
+        out = parallel.merge_microbatches(run(stacked, mb))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._sequential(weights, x)), atol=1e-6
+        )
