@@ -1,0 +1,153 @@
+"""MNIST train-and-evaluate with a dedicated evaluator node.
+
+Parity with the reference's estimator example
+(/root/reference/examples/mnist/estimator/mnist_tf.py:109 — the only
+reference workload that sets ``eval_node=True``): workers train and
+checkpoint; the evaluator node continuously evaluates the newest checkpoint
+and writes eval records next to the model, until the driver shuts the
+cluster down (TF's train_and_evaluate loop, reborn as explicit roles).
+
+Usage:
+    python examples/mnist/mnist_estimator.py --cluster_size 3 \
+        --model_dir /tmp/mnist_est --platform cpu
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main_fun(args, ctx):
+    if ctx.job_name == "evaluator":
+        _evaluate_forever(args, ctx)
+    else:
+        _train(args, ctx)
+
+
+def _train(args, ctx):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import parallel
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.train import SyncDataParallel, checkpoint, steps_per_worker
+
+    mesh = parallel.local_mesh({"dp": -1}) if ctx.num_processes == 1 else ctx.mesh({"dp": -1})
+    strategy = SyncDataParallel(mesh)
+    model = mnist.create_model("mlp")
+    optimizer = optax.adam(args.learning_rate)
+    state = strategy.create_state(mnist.make_init_fn(model), optimizer, jax.random.PRNGKey(0))
+    step = strategy.compile_train_step(mnist.make_loss_fn(model), optimizer, has_aux=True)
+
+    max_steps = steps_per_worker(args.num_examples * args.epochs, args.batch_size, ctx.num_workers)
+    feed = ctx.get_data_feed(train_mode=True)
+    steps = 0
+    is_saver = ctx.distributed or ctx.job_name in ("chief", "master") or ctx.num_workers <= 1
+    while not feed.should_stop() and steps < max_steps:
+        batch = feed.next_batch(args.batch_size)
+        if not batch:
+            break
+        images = np.asarray([b[0] for b in batch], np.float32).reshape(-1, 28, 28)
+        labels = np.asarray([b[1] for b in batch])
+        state, metrics = step(state, strategy.shard_batch({"image": images, "label": labels}))
+        steps += 1
+        if steps % args.checkpoint_steps == 0 and is_saver:
+            checkpoint.save_checkpoint(
+                os.path.join(args.model_dir, "ckpt_{}".format(steps)), jax.device_get(state))
+            print("saved ckpt_{} (loss {:.4f})".format(steps, float(metrics["loss"])))
+    if is_saver and steps % args.checkpoint_steps != 0:
+        # final model state — the checkpoint the evaluator's last record
+        # must come from (train_and_evaluate parity)
+        checkpoint.save_checkpoint(
+            os.path.join(args.model_dir, "ckpt_{}".format(steps)), jax.device_get(state))
+        print("saved final ckpt_{}".format(steps))
+    if not feed.should_stop():
+        feed.terminate()
+
+
+def _evaluate_forever(args, ctx):
+    """The evaluator role: eval every new checkpoint until shutdown
+    (reference estimator continuous-eval loop)."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.train import checkpoint
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from mnist_data_setup import synthetic_mnist
+
+    model = mnist.create_model("mlp")
+    images, labels = synthetic_mnist(args.eval_examples, seed=99)
+    seen = set()
+    while True:  # terminated by driver shutdown
+        latest = checkpoint.latest_checkpoint(args.model_dir)
+        if latest and latest not in seen:
+            seen.add(latest)
+            state = checkpoint.restore_checkpoint(latest)
+            logits = model.apply({"params": state.params}, np.asarray(images, np.float32))
+            acc = float(np.mean(np.argmax(np.asarray(logits), -1) == labels))
+            record = {"checkpoint": os.path.basename(latest), "accuracy": acc}
+            with open(os.path.join(args.model_dir, "eval_results.jsonl"), "a") as f:
+                f.write(json.dumps(record) + "\n")
+            print("evaluated {}: accuracy {:.3f}".format(record["checkpoint"], acc))
+        time.sleep(0.5)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=64)
+    parser.add_argument("--checkpoint_steps", type=int, default=10)
+    parser.add_argument("--cluster_size", type=int, default=3)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--eval_examples", type=int, default=256)
+    parser.add_argument("--learning_rate", type=float, default=1e-3)
+    parser.add_argument("--model_dir", required=True)
+    parser.add_argument("--num_examples", type=int, default=2048)
+    parser.add_argument("--platform", default=None)
+    args = parser.parse_args(argv)
+
+    from tensorflowonspark_tpu import TFCluster
+    from tensorflowonspark_tpu.backends.local import LocalSparkContext
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from mnist_data_setup import synthetic_mnist
+
+    images, labels = synthetic_mnist(args.num_examples)
+    data = [(images[i].ravel().tolist(), int(labels[i])) for i in range(len(labels))]
+
+    sc = LocalSparkContext(num_executors=args.cluster_size)
+    env = {"JAX_PLATFORMS": args.platform} if args.platform else None
+    try:
+        cluster = TFCluster.run(
+            sc, main_fun, args, args.cluster_size,
+            input_mode=TFCluster.InputMode.SPARK, master_node="chief",
+            eval_node=True, env=env,
+        )
+        cluster.train(sc.parallelize(data, 4), num_epochs=args.epochs)
+        # wait until the NEWEST checkpoint has an eval record (not merely the
+        # first one) before tearing the evaluator down
+        from tensorflowonspark_tpu.train import checkpoint as ckpt_lib
+
+        deadline = time.time() + 60
+        results = os.path.join(args.model_dir, "eval_results.jsonl")
+        while time.time() < deadline:
+            latest = ckpt_lib.latest_checkpoint(args.model_dir)
+            if latest and os.path.exists(results) and os.path.basename(latest) in open(results).read():
+                break
+            time.sleep(0.5)
+        cluster.shutdown(grace_secs=5)
+        if os.path.exists(results):
+            with open(results) as f:
+                print("eval records:\n" + f.read().strip())
+        print("estimator training complete")
+    finally:
+        sc.stop()
+
+
+if __name__ == "__main__":
+    main()

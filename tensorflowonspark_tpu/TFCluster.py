@@ -142,6 +142,7 @@ class TFCluster:
             except TypeError:  # non-pyspark signature
                 ssc.stop()
 
+        role_errors = []
         try:
             if self.input_mode == InputMode.SPARK:
                 self._shutdown_workers(grace_secs)
@@ -149,11 +150,22 @@ class TFCluster:
             # even when a worker surfaced an error, stop driver-managed roles,
             # reap the launch job, and release the reservation server — a
             # long-lived driver must be able to retry cluster.run without
-            # leaking server threads/sockets
+            # leaking server threads/sockets. ps/evaluator error queues are
+            # peeked here: nothing else ever reads them (workers surface
+            # their errors through the feed tasks / _shutdown_workers).
             for row in self.cluster_info:
                 if row.get("manager_addr"):
                     try:
                         mgr = TFManager.connect(tuple(row["manager_addr"]), self.cluster_meta["authkey"])
+                        if row["job_name"] in ("ps", "evaluator"):
+                            eq = mgr.get_queue("error")
+                            if not eq.empty():
+                                tb = eq.get(block=False)
+                                eq.put(tb)  # peek-and-requeue
+                                eq.task_done()
+                                role_errors.append(
+                                    "node {}:{}:\n{}".format(row["job_name"], row["task_index"], tb)
+                                )
                         mgr.get_queue("control").put(None, block=True)
                     except Exception as e:
                         logger.warning(
@@ -166,6 +178,8 @@ class TFCluster:
             raise RuntimeError("cluster did not shut down within {}s".format(timeout))
         if self.tf_status.get("error"):
             raise RuntimeError("cluster failed: {}".format(self.tf_status["error"]))
+        if role_errors:
+            raise RuntimeError("error(s) in driver-managed roles:\n" + "\n".join(role_errors))
         logger.info("cluster shut down cleanly")
 
     def _shutdown_workers(self, grace_secs):

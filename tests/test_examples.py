@@ -46,6 +46,19 @@ def test_mnist_spark_mode(tmp_path):
     assert os.path.isdir(export_dir)
 
 
+def test_mnist_estimator_with_evaluator(tmp_path):
+    model_dir = str(tmp_path / "est")
+    out = _run(
+        "mnist/mnist_estimator.py", "--cluster_size", "2", "--epochs", "1",
+        "--num_examples", "512", "--batch_size", "64", "--checkpoint_steps", "4",
+        "--model_dir", model_dir, "--platform", "cpu", timeout=420,
+    )
+    assert "estimator training complete" in out
+    results = os.path.join(model_dir, "eval_results.jsonl")
+    assert os.path.exists(results), out[-2000:]
+    assert "accuracy" in open(results).read()
+
+
 def test_mnist_streaming(tmp_path):
     out = _run(
         "mnist/mnist_spark_streaming.py", "--cluster_size", "1",

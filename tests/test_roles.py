@@ -142,3 +142,29 @@ def test_ps_and_evaluator_roles(tmp_path):
     # parked ps/evaluator roles were released promptly, not via the 3-day
     # watchdog (reference TFCluster.py:136-144)
     assert teardown < 120, teardown
+
+
+def fn_evaluator_crashes(args, ctx):
+    if ctx.job_name == "evaluator":
+        raise RuntimeError("deliberate evaluator failure")
+    feed = ctx.get_data_feed(train_mode=True)
+    while not feed.should_stop():
+        feed.next_batch(16)
+
+
+def test_evaluator_error_surfaces_at_shutdown(tmp_path):
+    """A crashed driver-managed role must fail shutdown, not be swallowed
+    (its error queue has no feed task to surface it through)."""
+    sc = LocalSparkContext(num_executors=2, task_timeout=240)
+    try:
+        cluster = TFCluster.run(
+            sc, fn_evaluator_crashes, {}, num_executors=2,
+            master_node="chief", eval_node=True,
+            input_mode=InputMode.SPARK, env=CPU_ENV, jax_distributed=False,
+            reservation_timeout=120,
+        )
+        cluster.train(sc.parallelize(range(64), 2), num_epochs=1, feed_timeout=120)
+        with pytest.raises(RuntimeError, match="deliberate evaluator failure"):
+            cluster.shutdown(grace_secs=1, timeout=240)
+    finally:
+        sc.stop()
