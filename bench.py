@@ -112,12 +112,16 @@ def bench_resnet(tiny, real_data):
     try:
         for _ in range(3):  # warmup: compile + steady state
             state, metrics = step(state, next(batches))
-        jax.block_until_ready(metrics["loss"])
+        float(np.asarray(jax.device_get(metrics["loss"])))
 
         t0 = time.perf_counter()
         for _ in range(steps):
             state, metrics = step(state, next(batches))
-        jax.block_until_ready(metrics["loss"])
+        # HOST TRANSFER, not block_until_ready: on relayed/tunneled TPU
+        # runtimes block_until_ready can return at the ack, not at compute
+        # completion — the transfer of the last step's loss (which depends
+        # on every prior step) is the only trustworthy fence
+        float(np.asarray(jax.device_get(metrics["loss"])))
         dt = time.perf_counter() - t0
     finally:
         if tmp:

@@ -44,6 +44,12 @@ def _train(args, ctx):
     state = strategy.create_state(mnist.make_init_fn(model), optimizer, jax.random.PRNGKey(0))
     step = strategy.compile_train_step(mnist.make_loss_fn(model), optimizer, has_aux=True)
 
+    # LOCKSTEP INVARIANT (multi-process worlds): every training process must
+    # execute the same number of collective steps — and therefore the same
+    # checkpoint saves — or the world deadlocks at the first divergence. The
+    # 0.9 safety factor in steps_per_worker is what guarantees every worker's
+    # feed can fill max_steps batches despite uneven partitions (the
+    # reference's 90%-of-steps trick, mnist_spark.py:58-64).
     max_steps = steps_per_worker(args.num_examples * args.epochs, args.batch_size, ctx.num_workers)
     feed = ctx.get_data_feed(train_mode=True)
     steps = 0

@@ -158,11 +158,8 @@ class TFCluster:
                     try:
                         mgr = TFManager.connect(tuple(row["manager_addr"]), self.cluster_meta["authkey"])
                         if row["job_name"] in ("ps", "evaluator"):
-                            eq = mgr.get_queue("error")
-                            if not eq.empty():
-                                tb = eq.get(block=False)
-                                eq.put(tb)  # peek-and-requeue
-                                eq.task_done()
+                            tb = TFSparkNode.peek_error(mgr)
+                            if tb is not None:
                                 role_errors.append(
                                     "node {}:{}:\n{}".format(row["job_name"], row["task_index"], tb)
                                 )
@@ -177,7 +174,14 @@ class TFCluster:
         if self.launch_thread.is_alive():
             raise RuntimeError("cluster did not shut down within {}s".format(timeout))
         if self.tf_status.get("error"):
-            raise RuntimeError("cluster failed: {}".format(self.tf_status["error"]))
+            raise RuntimeError(
+                "cluster failed: {}{}".format(
+                    self.tf_status["error"],
+                    "\nadditionally, driver-managed role error(s):\n" + "\n".join(role_errors)
+                    if role_errors
+                    else "",
+                )
+            )
         if role_errors:
             raise RuntimeError("error(s) in driver-managed roles:\n" + "\n".join(role_errors))
         logger.info("cluster shut down cleanly")

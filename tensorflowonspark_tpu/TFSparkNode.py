@@ -452,17 +452,26 @@ def _connect_executor_channel():
     return state, TFManager.connect(state["address"], state["authkey"])
 
 
-def _raise_if_remote_error(mgr):
+def peek_error(mgr):
+    """Non-destructively read a traceback from a node's error queue, or None.
+
+    The peek-and-requeue keeps the error visible to later tasks too
+    (reference trick, TFSparkNode.py:576-582)."""
     eq = mgr.get_queue("error")
-    if not eq.empty():
-        try:
-            tb = eq.get(block=False)
-        except Exception:
-            return
-        # keep the error visible to later tasks too (reference peek-and-requeue
-        # trick, TFSparkNode.py:576-582)
-        eq.put(tb)
-        eq.task_done()
+    if eq.empty():
+        return None
+    try:
+        tb = eq.get(block=False)
+    except Exception:
+        return None
+    eq.put(tb)
+    eq.task_done()
+    return tb
+
+
+def _raise_if_remote_error(mgr):
+    tb = peek_error(mgr)
+    if tb is not None:
         raise RuntimeError("error in jax child process:\n{}".format(tb))
 
 

@@ -120,3 +120,46 @@ def test_steps_per_worker():
     # 60000 MNIST examples, batch 64, 3 workers -> int(312 * 0.9) = 280
     assert steps_per_worker(60000, 64, 3) == 280
     assert steps_per_worker(10, 64, 3) == 1  # never zero
+
+
+def test_compile_train_loop_matches_sequential_steps():
+    """K scanned steps inside one jit == K sequential step() calls."""
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import parallel
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.train import SyncDataParallel
+
+    mesh = parallel.build_mesh({"dp": 8})
+    strategy = SyncDataParallel(mesh)
+    model = mnist.create_model("mlp", hidden=16)
+    opt = optax.sgd(0.1)
+    rng = np.random.default_rng(0)
+    K = 4
+    batches = {
+        "image": rng.standard_normal((K, 16, 28, 28)).astype(np.float32),
+        "label": rng.integers(0, 10, (K, 16)),
+    }
+
+    state_a = strategy.create_state(mnist.make_init_fn(model), opt, jax.random.PRNGKey(0))
+    loop = strategy.compile_train_loop(mnist.make_loss_fn(model), opt, K, has_aux=True, donate=False)
+    state_a, metrics = loop(state_a, strategy.shard_stacked_batches(batches))
+    jax.block_until_ready(metrics["loss"])
+    # step-count mismatch is a loud error, not a silent shorter run
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="steps"):
+        bad = {name: vals[:2] for name, vals in batches.items()}
+        loop(state_a, strategy.shard_stacked_batches(bad))
+
+    state_b = strategy.create_state(mnist.make_init_fn(model), opt, jax.random.PRNGKey(0))
+    step = strategy.compile_train_step(mnist.make_loss_fn(model), opt, has_aux=True, donate=False)
+    for k in range(K):
+        batch = {name: vals[k] for name, vals in batches.items()}
+        state_b, m = step(state_b, strategy.shard_batch(batch))
+        jax.block_until_ready(m["loss"])
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(m["loss"]), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
