@@ -247,6 +247,12 @@ class TFCluster:
 def build_cluster_template(num_executors, num_ps=0, master_node="chief", eval_node=False):
     """executor_id → (job_name, task_index), in the reference's role order
     ps → chief → evaluator → worker (TFCluster.py:252-267)."""
+    if master_node is not None and master_node not in ("chief", "master"):
+        # catches stringified-None and typos before they become silent
+        # do-nothing roles in a live cluster
+        raise ValueError(
+            "master_node must be 'chief', 'master', or None; got {!r}".format(master_node)
+        )
     roles = ["ps"] * num_ps
     if master_node:
         roles.append(master_node)

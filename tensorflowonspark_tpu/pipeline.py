@@ -59,7 +59,11 @@ class Params:
             if name not in params:
                 raise ValueError("unknown param {!r}".format(name))
             p = params[name]
-            self._paramMap[p.name] = p.converter(value) if p.converter else value
+            # None passes through un-coerced: str(None) == "None" would turn
+            # setMasterNode(None) into a bogus "None" cluster role
+            self._paramMap[p.name] = (
+                p.converter(value) if p.converter and value is not None else value
+            )
         return self
 
     def _setDefault(self, **kwargs):
@@ -450,6 +454,20 @@ class TFEstimator(TFParams, HasBatchSize, HasClusterSize, HasEpochs, HasGraceSec
         sc = getattr(rdd, "_sc", None)  # local backend
         if sc is None:
             sc = rdd.context  # real pyspark
+
+        tfrecord_dir = getattr(args, "tfrecord_dir", None)
+        if tfrecord_dir:
+            # materialize the input DataFrame as TFRecord shards so training
+            # code can read files directly (the reference's dfutil flow);
+            # provenance-aware: a DataFrame that was LOADED from this very
+            # directory is not re-written (reference loadedDF registry,
+            # dfutil.py:15-26)
+            from tensorflowonspark_tpu import dfutil
+
+            if dfutil.isLoadedDF(dataset) and dfutil.loadedDFSource(dataset) == tfrecord_dir:
+                logger.info("input DataFrame already lives at %s; reusing", tfrecord_dir)
+            else:
+                dfutil.saveAsTFRecords(dataset, tfrecord_dir)
 
         env = dict(self.env or {})
         if getattr(args, "readers", 0):

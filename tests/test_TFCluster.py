@@ -139,3 +139,7 @@ class TestClusterTemplate:
     def test_too_small(self):
         with pytest.raises(ValueError):
             TFCluster.build_cluster_template(1, num_ps=1, master_node=None)
+
+    def test_bogus_master_node_rejected(self):
+        with pytest.raises(ValueError, match="master_node"):
+            TFCluster.build_cluster_template(2, master_node="None")

@@ -162,3 +162,38 @@ def test_fit_and_transform(sc, tmp_path_factory):
     # cluster) and only the chief exports, so convergence is approximate: the
     # check is that the exported bundle predicts the right function shape
     np.testing.assert_allclose(np.asarray(preds).ravel(), expected, atol=0.5)
+
+
+def test_tfrecord_dir_materializes_and_reuses(sc, tmp_path):
+    """setTFRecordDir materializes the input DataFrame as shards; a DataFrame
+    loaded FROM that directory is not re-written (provenance reuse, reference
+    dfutil.py:15-26 loadedDF registry)."""
+    import time as _time
+
+    from tensorflowonspark_tpu import dfutil
+
+    tfr_dir = str(tmp_path / "tfr")
+
+    def train_noop(args, ctx):
+        feed = ctx.get_data_feed(train_mode=True)
+        while not feed.should_stop():
+            feed.next_batch(16)
+
+    df = sc.createDataFrame([(i, float(i)) for i in range(32)], ["a", "b"], 2)
+    est = (
+        pipeline.TFEstimator(train_noop, {}, env={"JAX_PLATFORMS": "cpu"})
+        .setInputMapping({"a": "a", "b": "b"})
+        .setEpochs(1)
+        .setClusterSize(2)
+        .setMasterNode(None)
+        .setTFRecordDir(tfr_dir)
+    )
+    est.fit(df)
+    shards = dfutil.tfrecord.list_shards(tfr_dir)
+    assert shards, "tfrecord_dir was not materialized"
+    mtimes = {s: os.path.getmtime(s) for s in shards}
+
+    _time.sleep(0.05)
+    loaded = dfutil.loadTFRecords(sc, tfr_dir)
+    est.fit(loaded)  # provenance hit: must NOT rewrite the shards
+    assert {s: os.path.getmtime(s) for s in dfutil.tfrecord.list_shards(tfr_dir)} == mtimes
