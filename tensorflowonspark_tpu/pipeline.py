@@ -28,6 +28,13 @@ logger = logging.getLogger(__name__)
 # -- param plumbing (pyspark.ml.param.Param equivalent) ------------------------
 
 
+def _nullable_str(value):
+    """str converter that keeps None as None: str(None) == "None" would turn
+    e.g. setMasterNode(None) into a bogus 'None' cluster role, and
+    setModelDir(None) into a directory literally named None."""
+    return None if value is None else str(value)
+
+
 class Param:
     def __init__(self, name, doc, converter=None):
         self.name = name
@@ -59,11 +66,7 @@ class Params:
             if name not in params:
                 raise ValueError("unknown param {!r}".format(name))
             p = params[name]
-            # None passes through un-coerced: str(None) == "None" would turn
-            # setMasterNode(None) into a bogus "None" cluster role
-            self._paramMap[p.name] = (
-                p.converter(value) if p.converter and value is not None else value
-            )
+            self._paramMap[p.name] = p.converter(value) if p.converter else value
         return self
 
     def _setDefault(self, **kwargs):
@@ -194,7 +197,7 @@ class HasInputMode(Params):
 
 
 class HasMasterNode(Params):
-    master_node = Param("master_node", "job name of the master/chief node", str)
+    master_node = Param("master_node", "job name of the master/chief node", _nullable_str)
 
     def __init__(self):
         super().__init__()
@@ -208,7 +211,7 @@ class HasMasterNode(Params):
 
 
 class HasModelDir(Params):
-    model_dir = Param("model_dir", "directory to write checkpoints", str)
+    model_dir = Param("model_dir", "directory to write checkpoints", _nullable_str)
 
     def __init__(self):
         super().__init__()
@@ -321,7 +324,7 @@ class HasTensorboard(Params):
 
 
 class HasTFRecordDir(Params):
-    tfrecord_dir = Param("tfrecord_dir", "directory of TFRecords to use as input", str)
+    tfrecord_dir = Param("tfrecord_dir", "directory of TFRecords to use as input", _nullable_str)
 
     def __init__(self):
         super().__init__()
@@ -334,7 +337,7 @@ class HasTFRecordDir(Params):
 
 
 class HasExportDir(Params):
-    export_dir = Param("export_dir", "directory to export the trained model bundle", str)
+    export_dir = Param("export_dir", "directory to export the trained model bundle", _nullable_str)
 
     def __init__(self):
         super().__init__()
@@ -347,7 +350,7 @@ class HasExportDir(Params):
 
 
 class HasSignatureDefKey(Params):
-    signature_def_key = Param("signature_def_key", "bundle signature to use (API compat)", str)
+    signature_def_key = Param("signature_def_key", "bundle signature to use (API compat)", _nullable_str)
 
     def __init__(self):
         super().__init__()
@@ -361,7 +364,7 @@ class HasSignatureDefKey(Params):
 
 
 class HasTagSet(Params):
-    tag_set = Param("tag_set", "bundle tag set (API compat)", str)
+    tag_set = Param("tag_set", "bundle tag set (API compat)", _nullable_str)
 
     def __init__(self):
         super().__init__()
@@ -457,17 +460,25 @@ class TFEstimator(TFParams, HasBatchSize, HasClusterSize, HasEpochs, HasGraceSec
 
         tfrecord_dir = getattr(args, "tfrecord_dir", None)
         if tfrecord_dir:
-            # materialize the input DataFrame as TFRecord shards so training
-            # code can read files directly (the reference's dfutil flow);
-            # provenance-aware: a DataFrame that was LOADED from this very
-            # directory is not re-written (reference loadedDF registry,
-            # dfutil.py:15-26)
-            from tensorflowonspark_tpu import dfutil
+            # materialize the input DataFrame as TFRecord shards
+            # (reference dfutil flow), provenance-aware: a DataFrame that was
+            # LOADED from this very directory is not re-written (reference
+            # loadedDF registry, dfutil.py:15-26). The feed then reads the
+            # materialized shards, so the source DataFrame is evaluated at
+            # most once per fit.
+            import os as _os
 
+            from tensorflowonspark_tpu import dfutil, tfrecord
+
+            if not tfrecord.is_uri(tfrecord_dir):  # match loadTFRecords' form
+                tfrecord_dir = _os.path.abspath(_os.path.expanduser(tfrecord_dir))
             if dfutil.isLoadedDF(dataset) and dfutil.loadedDFSource(dataset) == tfrecord_dir:
                 logger.info("input DataFrame already lives at %s; reusing", tfrecord_dir)
             else:
                 dfutil.saveAsTFRecords(dataset, tfrecord_dir)
+            # feed from the shards, not the source DataFrame: no second
+            # evaluation of an expensive input
+            dataset = dfutil.loadTFRecords(sc, tfrecord_dir, columns=list(dataset.columns))
 
         env = dict(self.env or {})
         if getattr(args, "readers", 0):
