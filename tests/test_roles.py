@@ -164,6 +164,17 @@ def test_evaluator_error_surfaces_at_shutdown(tmp_path):
             reservation_timeout=120,
         )
         cluster.train(sc.parallelize(range(64), 2), num_epochs=1, feed_timeout=120)
+        # deterministic: wait until the evaluator child has actually crashed
+        # (posted its traceback) before shutdown peeks the error queues —
+        # under load the spawned child may still be importing
+        from tensorflowonspark_tpu import TFManager
+
+        row = next(r for r in cluster.cluster_info if r["job_name"] == "evaluator")
+        mgr = TFManager.connect(tuple(row["manager_addr"]), cluster.cluster_meta["authkey"])
+        deadline = time.time() + 120
+        while mgr.get("child_status") != "failed" and time.time() < deadline:
+            time.sleep(0.2)
+        assert mgr.get("child_status") == "failed"
         with pytest.raises(RuntimeError, match="deliberate evaluator failure"):
             cluster.shutdown(grace_secs=1, timeout=240)
     finally:
