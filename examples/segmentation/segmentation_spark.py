@@ -92,6 +92,31 @@ def main_fun(args, ctx):
         print("exported segmentation bundle to", args.export_dir)
 
 
+def inference_fun(args, ctx):
+    """Independent-instance inference from the exported bundle: each
+    TFParallel worker segments its own shard of images (the multi-worker
+    inference leg of BASELINE config 5; reference pattern:
+    mnist/keras/mnist_inference.py ds.shard per worker)."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.train import export
+
+    predict_fn, params, model_state = export.load_model(args.export_dir)
+    images, masks = synthetic_shapes(
+        args.inference_count, args.image_size, seed=1000 + ctx.executor_id
+    )
+    # shard: this worker's slice of the global workload
+    sel = np.arange(ctx.executor_id, len(images), max(ctx.num_workers, 1))
+    out = predict_fn(params, model_state, {"image": images[sel]})
+    pred = np.asarray(out["mask"])
+    acc = float(np.mean(pred == masks[sel]))
+    path = os.path.join(args.export_dir, "inference-{}.txt".format(ctx.executor_id))
+    with open(path, "w") as f:
+        f.write("{} {} {:.4f}".format(len(sel), pred.shape[1], acc))
+    print("worker {} segmented {} images (pixel acc {:.3f})".format(
+        ctx.executor_id, len(sel), acc))
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--base_filters", type=int, default=16)
@@ -100,11 +125,12 @@ def main(argv=None):
     parser.add_argument("--depth", type=int, default=3)
     parser.add_argument("--export_dir", default=None)
     parser.add_argument("--image_size", type=int, default=128)
+    parser.add_argument("--inference_count", type=int, default=16)
     parser.add_argument("--train_steps", type=int, default=20)
     parser.add_argument("--platform", default=None)
     args = parser.parse_args(argv)
 
-    from tensorflowonspark_tpu import TFCluster
+    from tensorflowonspark_tpu import TFCluster, TFParallel
     from tensorflowonspark_tpu.backends.local import LocalSparkContext
 
     sc = LocalSparkContext(num_executors=args.cluster_size)
@@ -116,6 +142,10 @@ def main(argv=None):
         )
         cluster.shutdown()
         print("segmentation training complete")
+        if args.export_dir:
+            # multi-worker inference: N independent instances over the bundle
+            TFParallel.run(sc, inference_fun, args, args.cluster_size, env=env)
+            print("segmentation inference complete")
     finally:
         sc.stop()
 

@@ -69,12 +69,17 @@ def test_mnist_streaming(tmp_path):
 
 
 def test_segmentation_spark(tmp_path):
+    export_dir = str(tmp_path / "seg_bundle")
     out = _run(
         "segmentation/segmentation_spark.py", "--cluster_size", "1",
         "--train_steps", "4", "--image_size", "32", "--depth", "2",
         "--base_filters", "8", "--batch_size", "4", "--platform", "cpu",
+        "--export_dir", export_dir, "--inference_count", "8",
     )
     assert "segmentation training complete" in out
+    # multi-worker (independent instance) inference over the exported bundle
+    assert "segmentation inference complete" in out
+    assert os.path.isfile(os.path.join(export_dir, "inference-0.txt"))
 
 
 @pytest.mark.slow
