@@ -41,10 +41,9 @@ REFERENCE_IMG_PER_SEC_PER_CHIP = 2000.0
 
 def _force_platform_for_tiny(tiny):
     if tiny:
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        import jax
+        from tensorflowonspark_tpu.util import force_platform
 
-        jax.config.update("jax_platforms", "cpu")
+        force_platform("cpu")
 
 
 def bench_resnet(tiny, real_data):

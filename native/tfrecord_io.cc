@@ -158,7 +158,10 @@ TfrFile* tfr_load(const char* path, int verify_crc) {
         set_err("corrupt length crc in %s (record %llu)", path, count);
         goto fail;
       }
-      if (pos + 12 + len + 4 > n) {
+      // overflow-safe: `pos + 12 + len + 4 > n` wraps for a corrupt huge
+      // len; compare against the remaining bytes instead
+      uint64_t remaining = n - pos;  // >= 12 per the header check above
+      if (remaining < 16 || len > remaining - 16) {
         set_err("truncated payload in %s (record %llu)", path, count);
         goto fail;
       }

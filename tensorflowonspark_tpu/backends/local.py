@@ -263,11 +263,14 @@ class LocalStreamingContext:
     def start(self):
         def _run():
             while not self._stop_ev.is_set():
-                try:
-                    rdd = self._queue.get(timeout=self.batch_interval)
-                except queue.Empty:
-                    continue
+                # dequeue AND handle under one lock hold: a batch popped but
+                # not yet feeding must be invisible to stop()'s graceful
+                # drain, or it feeds after the end-of-feed markers
                 with self._busy:
+                    try:
+                        rdd = self._queue.get(timeout=self.batch_interval)
+                    except queue.Empty:
+                        continue
                     for stream in self._streams:
                         for handler in stream._handlers:
                             try:

@@ -111,11 +111,14 @@ def read_records(path, verify_crc=True):
         raise IOError(lib.tfr_last_error().decode() or "tfr_load failed on {}".format(path))
     try:
         count = lib.tfr_count(handle)
-        buf = lib.tfr_buffer(handle)
+        base = ctypes.cast(lib.tfr_buffer(handle), ctypes.c_void_p).value
         offsets = lib.tfr_offsets(handle)
         lengths = lib.tfr_lengths(handle)
-        raw = ctypes.string_at(buf, lib.tfr_buffer_len(handle))
-        return [raw[offsets[i] : offsets[i] + lengths[i]] for i in range(count)]
+        # one copy per record straight out of the C buffer (a whole-file
+        # bytes intermediate would double peak memory on the ingest path)
+        return [
+            ctypes.string_at(base + offsets[i], lengths[i]) for i in range(count)
+        ]
     finally:
         lib.tfr_free(handle)
 

@@ -71,3 +71,18 @@ def test_tf_interop(tmp_path):
         for r in recs:
             w.write(r)
     assert native_io.read_records(path2) == recs
+
+
+def test_huge_length_field_rejected(tmp_path):
+    """A corrupt 8-byte length near UINT64_MAX must produce a clean error,
+    not an out-of-bounds read (the `pos + len` sum would wrap)."""
+    import struct
+
+    path = str(tmp_path / "huge.tfrecord")
+    payload = b"x" * 10
+    header = struct.pack("<Q", 0xFFFFFFFFFFFFFFF0)
+    open(path, "wb").write(header + b"\x00" * 4 + payload + b"\x00" * 4)
+    with pytest.raises(IOError):
+        native_io.read_records(path, verify_crc=True)
+    with pytest.raises(IOError):
+        native_io.read_records(path, verify_crc=False)
