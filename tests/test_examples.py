@@ -25,6 +25,7 @@ def _run(script, *args, timeout=420):
     return proc.stdout
 
 
+@pytest.mark.slow
 def test_mnist_data_setup_and_tf_mode(tmp_path):
     data = str(tmp_path / "tfr")
     _run("mnist/mnist_data_setup.py", "--output", data, "--num_examples", "512")
@@ -46,6 +47,7 @@ def test_mnist_spark_mode(tmp_path):
     assert os.path.isdir(export_dir)
 
 
+@pytest.mark.slow
 def test_mnist_estimator_with_evaluator(tmp_path):
     model_dir = str(tmp_path / "est")
     out = _run(
@@ -68,6 +70,7 @@ def test_mnist_streaming(tmp_path):
     assert "streaming training complete" in out
 
 
+@pytest.mark.slow
 def test_segmentation_spark(tmp_path):
     export_dir = str(tmp_path / "seg_bundle")
     out = _run(
