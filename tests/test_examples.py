@@ -112,3 +112,27 @@ def test_resnet_real_data_end_to_end(tmp_path):
     )
     assert "resnet training complete" in out
     assert os.path.isdir(os.path.join(model_dir, "ckpt_3"))
+
+
+@pytest.mark.slow
+def test_mnist_pipeline_then_parallel_inference(tmp_path):
+    """The remaining two BASELINE mnist configs at example level: the
+    Spark-ML pipeline (TFEstimator fit -> bundle -> TFModel transform) and
+    TFParallel independent-instance inference over the exported bundle."""
+    export_dir = str(tmp_path / "bundle")
+    out = _run(
+        "mnist/mnist_pipeline.py", "--cluster_size", "1", "--epochs", "1",
+        "--num_examples", "256", "--batch_size", "32",
+        "--export_dir", export_dir, "--platform", "cpu",
+    )
+    assert "pipeline inference accuracy" in out
+    assert os.path.isdir(export_dir)
+
+    pred_out = str(tmp_path / "preds")
+    out2 = _run(
+        "mnist/mnist_inference.py", "--cluster_size", "2",
+        "--num_examples", "128", "--batch_size", "64",
+        "--export_dir", export_dir, "--output", pred_out, "--platform", "cpu",
+    )
+    assert "inference shards in" in out2
+    assert os.listdir(pred_out)
