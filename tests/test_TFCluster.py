@@ -67,7 +67,7 @@ class TestTFCluster:
         cluster = TFCluster.run(
             sc, fn_write_marker, {"out_dir": str(tmp_path)}, num_executors=2,
             input_mode=InputMode.TENSORFLOW, master_node=None,
-            env=CPU_ENV, jax_distributed=False, reservation_timeout=60,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
         )
         cluster.shutdown(timeout=120)
         files = sorted(os.listdir(str(tmp_path)))
@@ -77,7 +77,7 @@ class TestTFCluster:
         cluster = TFCluster.run(
             sc, fn_square_feed, {}, num_executors=2,
             input_mode=InputMode.SPARK, master_node=None,
-            env=CPU_ENV, jax_distributed=False, reservation_timeout=60,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
         )
         data = sc.parallelize(range(100), 4)
         results = cluster.inference(data).collect()
@@ -89,7 +89,7 @@ class TestTFCluster:
         cluster = TFCluster.run(
             sc, fn_square_feed_jax, {}, num_executors=2,
             input_mode=InputMode.SPARK, master_node=None,
-            env=CPU_ENV, jax_distributed=False, reservation_timeout=60,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
         )
         data = sc.parallelize(range(40), 2)
         results = cluster.inference(data, feed_timeout=300).collect()
@@ -100,7 +100,7 @@ class TestTFCluster:
         cluster = TFCluster.run(
             sc, fn_immediate_error, {}, num_executors=2,
             input_mode=InputMode.SPARK, master_node=None,
-            env=CPU_ENV, jax_distributed=False, reservation_timeout=60,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
         )
         with pytest.raises(TaskError, match="deliberate failure before"):
             cluster.train(sc.parallelize(range(1000), 4), feed_timeout=30)
@@ -111,7 +111,7 @@ class TestTFCluster:
         cluster = TFCluster.run(
             sc, fn_late_error, {}, num_executors=2,
             input_mode=InputMode.SPARK, master_node=None,
-            env=CPU_ENV, jax_distributed=False, reservation_timeout=60,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
         )
         cluster.train(sc.parallelize(range(64), 2), feed_timeout=60)
         with pytest.raises((TaskError, RuntimeError), match="after feeding finished"):
@@ -121,7 +121,7 @@ class TestTFCluster:
         cluster = TFCluster.run(
             sc, fn_consume_all, {}, num_executors=2,
             input_mode=InputMode.SPARK, master_node=None,
-            env=CPU_ENV, jax_distributed=False, reservation_timeout=60,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
         )
         cluster.train(sc.parallelize(range(200), 4), num_epochs=2, feed_timeout=60)
         cluster.shutdown(timeout=120)

@@ -137,7 +137,7 @@ def test_cluster_forms_distributed_world(tmp_path):
         cluster = TFCluster.run(
             sc, fn_distributed_train, {"out_dir": str(tmp_path)}, num_executors=2,
             input_mode=InputMode.TENSORFLOW, master_node=None,
-            env=CPU_ENV, jax_distributed=True, reservation_timeout=60,
+            env=CPU_ENV, jax_distributed=True, reservation_timeout=180,
         )
         cluster.shutdown(timeout=300)
     finally:

@@ -49,7 +49,7 @@ def test_waves_then_clean_shutdown(sc, tmp_path):
     cluster = TFCluster.run(
         sc, fn_count_rows, {"out_dir": str(tmp_path)}, num_executors=2,
         input_mode=InputMode.SPARK, master_node=None,
-        env=CPU_ENV, jax_distributed=False, reservation_timeout=60,
+        env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
     )
     ssc = LocalStreamingContext(sc, batch_interval=0.2)
     stream = ssc.queueStream()
@@ -67,7 +67,7 @@ def test_generator_of_rdds(sc, tmp_path):
     cluster = TFCluster.run(
         sc, fn_count_rows, {"out_dir": str(tmp_path)}, num_executors=2,
         input_mode=InputMode.SPARK, master_node=None,
-        env=CPU_ENV, jax_distributed=False, reservation_timeout=60,
+        env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
     )
 
     def waves():
@@ -84,7 +84,7 @@ def test_external_stop_ends_stream(sc, tmp_path):
     cluster = TFCluster.run(
         sc, fn_count_rows, {"out_dir": str(tmp_path)}, num_executors=2,
         input_mode=InputMode.SPARK, master_node=None,
-        env=CPU_ENV, jax_distributed=False, reservation_timeout=60,
+        env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
     )
     ssc = LocalStreamingContext(sc, batch_interval=0.2)
     stream = ssc.queueStream()
