@@ -35,12 +35,24 @@ import java.util.List;
  */
 public final class InferenceClient implements Closeable {
 
+  /** Default socket read timeout: a hung server fails the Spark task with a
+   *  clear SocketTimeoutException instead of blocking it forever. Generous
+   *  because the FIRST predict triggers XLA compilation on the server, which
+   *  can take minutes for large models; pass a tighter value via the 3-arg
+   *  constructor once the model is warm. */
+  public static final int DEFAULT_TIMEOUT_MILLIS = 600_000;
+
   private final Socket socket;
   private final DataInputStream in;
   private final DataOutputStream out;
 
   public InferenceClient(String host, int port) throws IOException {
+    this(host, port, DEFAULT_TIMEOUT_MILLIS);
+  }
+
+  public InferenceClient(String host, int port, int readTimeoutMillis) throws IOException {
     this.socket = new Socket(host, port);
+    this.socket.setSoTimeout(readTimeoutMillis);
     this.in = new DataInputStream(socket.getInputStream());
     this.out = new DataOutputStream(socket.getOutputStream());
   }
@@ -58,7 +70,7 @@ public final class InferenceClient implements Closeable {
     byte[] reply = new byte[length];
     in.readFully(reply);
     String text = new String(reply, StandardCharsets.UTF_8);
-    if (text.contains("\"type\": \"error\"") || text.contains("\"type\":\"error\"")) {
+    if ("error".equals(topLevelType(text))) {
       throw new IOException("server error: " + text);
     }
     return text;
@@ -99,6 +111,55 @@ public final class InferenceClient implements Closeable {
   @Override
   public void close() throws IOException {
     socket.close();
+  }
+
+  /**
+   * Value of the TOP-LEVEL {@code "type"} key of a JSON object, or null.
+   * Tracks nesting depth and string state so a payload that merely contains
+   * the text {@code "type": "error"} (e.g. an echoed column value) cannot
+   * false-positive the error check.
+   */
+  static String topLevelType(String s) {
+    int depth = 0;
+    boolean inString = false;
+    StringBuilder str = null;
+    String lastString = null;
+    for (int i = 0; i < s.length(); i++) {
+      char ch = s.charAt(i);
+      if (inString) {
+        if (ch == '\\') { i++; if (str != null) str.append(ch).append(i < s.length() ? s.charAt(i) : ' '); continue; }
+        if (ch == '"') { inString = false; lastString = str.toString(); str = null; continue; }
+        str.append(ch);
+        continue;
+      }
+      switch (ch) {
+        case '"': inString = true; str = new StringBuilder(); break;
+        case '{': case '[': depth++; break;
+        case '}': case ']': depth--; break;
+        case ':':
+          if (depth == 1 && "type".equals(lastString)) {
+            // the next string at depth 1 is the value
+            for (int j = i + 1; j < s.length(); j++) {
+              char v = s.charAt(j);
+              if (v == '"') {
+                int end = j + 1;
+                StringBuilder val = new StringBuilder();
+                while (end < s.length() && s.charAt(end) != '"') {
+                  if (s.charAt(end) == '\\' && ++end >= s.length()) break;
+                  val.append(s.charAt(end));
+                  end++;
+                }
+                return val.toString();
+              }
+              if (!Character.isWhitespace(v)) return null;  // non-string value
+            }
+            return null;
+          }
+          break;
+        default: break;
+      }
+    }
+    return null;
   }
 
   // -- minimal JSON helpers for the fixed shapes ---------------------------

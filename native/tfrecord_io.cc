@@ -31,10 +31,12 @@ namespace {
 // ---------------------------------------------------------------------------
 
 uint32_t kCrcTable[8][256];
-bool crc_init_done = false;
 
-void crc_init() {
-  if (crc_init_done) return;
+// Eager, synchronized table build: ctypes releases the GIL, so two threads
+// (e.g. two ImagePipeline producers) may enter tfr_load concurrently — a lazy
+// unsynchronized flag would race. Running once at library load removes the
+// window entirely.
+int crc_init() {
   const uint32_t poly = 0x82f63b78u;  // reflected CRC-32C polynomial
   for (uint32_t i = 0; i < 256; i++) {
     uint32_t crc = i;
@@ -48,11 +50,12 @@ void crc_init() {
       kCrcTable[t][i] = crc;
     }
   }
-  crc_init_done = true;
+  return 0;
 }
 
+const int kCrcInitToken = crc_init();  // static initializer, pre-main
+
 uint32_t crc32c(const uint8_t* data, size_t n) {
-  crc_init();
   uint32_t crc = 0xffffffffu;
   while (n >= 8) {
     uint64_t word;
@@ -145,6 +148,13 @@ TfrFile* tfr_load(const char* path, int verify_crc) {
   uint64_t cap = 1024, count = 0;
   uint64_t* offsets = (uint64_t*)malloc(cap * sizeof(uint64_t));
   uint64_t* lengths = (uint64_t*)malloc(cap * sizeof(uint64_t));
+  if (!offsets || !lengths) {
+    set_err("out of memory allocating record index for %s (record %llu)", path, 0);
+    free(buf);
+    free(offsets);
+    free(lengths);
+    return nullptr;
+  }
   uint64_t pos = 0, n = (uint64_t)sz;
   while (pos < n) {
     if (pos + 12 > n) {
@@ -172,8 +182,15 @@ TfrFile* tfr_load(const char* path, int verify_crc) {
       }
       if (count == cap) {
         cap *= 2;
-        offsets = (uint64_t*)realloc(offsets, cap * sizeof(uint64_t));
-        lengths = (uint64_t*)realloc(lengths, cap * sizeof(uint64_t));
+        uint64_t* new_offsets = (uint64_t*)realloc(offsets, cap * sizeof(uint64_t));
+        uint64_t* new_lengths = (uint64_t*)realloc(lengths, cap * sizeof(uint64_t));
+        if (new_offsets) offsets = new_offsets;
+        if (new_lengths) lengths = new_lengths;
+        if (!new_offsets || !new_lengths) {
+          set_err("out of memory growing record index for %s (record %llu)",
+                  path, count);
+          goto fail;
+        }
       }
       offsets[count] = pos + 12;
       lengths[count] = len;
@@ -183,6 +200,10 @@ TfrFile* tfr_load(const char* path, int verify_crc) {
   }
   {
     TfrFile* f = (TfrFile*)malloc(sizeof(TfrFile));
+    if (!f) {
+      set_err("out of memory for handle on %s (record %llu)", path, count);
+      goto fail;
+    }
     f->buf = buf;
     f->buf_len = n;
     f->offsets = offsets;

@@ -530,7 +530,14 @@ class _TrainPartitionTask:
 
 class _InferencePartitionTask:
     """Feeds one partition and collects exactly its results
-    (reference ``TFSparkNode.inference()._inference``, TFSparkNode.py:470-529)."""
+    (reference ``TFSparkNode.inference()._inference``, TFSparkNode.py:470-529).
+
+    REQUIRES one concurrent task per executor (spark.executor.cores=1 or
+    spark.task.cpus=executor cores) — the same hard invariant the reference
+    held (its TFSparkNode.py:116-119). Two inference tasks interleaving on
+    one executor channel could split a result chunk across collectors; the
+    collector below detects the resulting over-collection and fails loudly
+    rather than starving the peer task into a feed timeout."""
 
     def __init__(self, cluster_meta, qname_in="input", qname_out="output", feed_timeout=600, chunk_size=None):
         self.cluster_meta = cluster_meta
@@ -570,6 +577,13 @@ class _InferencePartitionTask:
                 results.extend(item.items)
             else:
                 results.append(item)
+        if len(results) > count:
+            raise RuntimeError(
+                "collected {} inference results for a {}-item partition: "
+                "another task is sharing this executor's channel — run "
+                "inference with one concurrent task per executor "
+                "(spark.executor.cores=1)".format(len(results), count)
+            )
         logger.info("collected %d inference results", len(results))
         return results
 

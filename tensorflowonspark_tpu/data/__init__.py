@@ -13,6 +13,7 @@ device mesh — static shapes and steady feed keep XLA and the MXU busy.
 from tensorflowonspark_tpu.data.loader import (  # noqa: F401
     ImagePipeline,
     device_prefetch,
+    loop_prefetch,
     shard_files,
 )
 from tensorflowonspark_tpu.data import cifar, imagenet  # noqa: F401

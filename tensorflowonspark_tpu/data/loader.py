@@ -123,7 +123,12 @@ class ImagePipeline:
                         batch.append(rec)
                         if len(batch) == self.batch_size:
                             parsed = list(pool.map(self.parse_fn, batch))
-                            images = np.stack([p[0] for p in parsed]).astype(np.float32)
+                            images = np.stack([p[0] for p in parsed])
+                            # parse_fn's dtype is respected (uint8 parses
+                            # quarter the host->device bytes; normalization
+                            # then runs on device) — only f64 is narrowed
+                            if images.dtype == np.float64:
+                                images = images.astype(np.float32)
                             labels = np.asarray([p[1] for p in parsed], np.int32)
                             out_q.put({"image": images, "label": labels})
                             batch = []
@@ -173,3 +178,31 @@ def device_prefetch(batches, strategy, depth=2):
         except StopIteration:
             pass
         yield out
+
+
+def loop_prefetch(batches, strategy, num_steps, depth=None):
+    """Group host batches into device-resident lists of ``num_steps`` for
+    :meth:`~tensorflowonspark_tpu.train.SyncDataParallel.compile_train_loop`.
+
+    Each batch is placed with ``strategy.shard_batch`` as it arrives — the
+    transfers are async and overlap the previous loop dispatch's compute —
+    and handed out in windows of ``num_steps``. ``depth`` is how many batches
+    beyond the current window stay in flight (default ``num_steps``, i.e.
+    the next window transfers while the current one trains). Short final
+    windows are dropped (the loop is compiled for a static ``num_steps``).
+    """
+    import collections
+
+    if depth is None:
+        depth = num_steps
+    buf = collections.deque()
+    it = iter(batches)
+    try:
+        while True:
+            while len(buf) < num_steps + depth:
+                buf.append(strategy.shard_batch(next(it)))
+            yield [buf.popleft() for _ in range(num_steps)]
+    except StopIteration:
+        pass
+    while len(buf) >= num_steps:
+        yield [buf.popleft() for _ in range(num_steps)]

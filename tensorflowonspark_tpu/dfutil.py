@@ -108,9 +108,11 @@ def saveAsTFRecords(df, output_dir, binary_features=()):
         if not examples:
             return []
         # commit protocol standing in for the Hadoop output committer: write
-        # to a temp name, then atomically rename onto the deterministic
-        # per-partition name — task retries/speculative duplicates overwrite
-        # instead of duplicating records
+        # to a temp name, then rename onto the deterministic per-partition
+        # name — task retries/speculative duplicates overwrite instead of
+        # duplicating records (atomic locally; on object stores the rename is
+        # delete+copy, so duplicates overwrite but the final shard may be
+        # transiently absent — see tfrecord.rename)
         final = "{}/part-r-{:05d}".format(output_dir.rstrip("/"), pidx)
         tmp = final + "." + _uuid.uuid4().hex[:8] + ".tmp"
         n = tfrecord.write_shard(tmp, examples)

@@ -150,15 +150,21 @@ def make_init_fn(model, image_size=224, channels=3):
     return init
 
 
-def make_loss_fn(model, weight_decay=1e-4, label_smoothing=0.0):
+def make_loss_fn(model, weight_decay=1e-4, label_smoothing=0.0, normalize=None):
     """Mutable loss for SyncDataParallel(compile_train_step(mutable=True)):
     threads batch_stats and applies the reference's L2 regularization
-    (resnet_model.py applies wd to conv/dense kernels)."""
+    (resnet_model.py applies wd to conv/dense kernels).
+
+    ``normalize`` — optional device-side preprocess applied to
+    ``batch["image"]`` before the model (e.g.
+    :func:`tensorflowonspark_tpu.data.imagenet.device_normalize` when the
+    feed ships raw uint8 pixels)."""
     import jax
 
     def loss_fn(params, model_state, batch):
+        images = batch["image"] if normalize is None else normalize(batch["image"])
         logits, new_model_state = model.apply(
-            {"params": params, **model_state}, batch["image"], train=True,
+            {"params": params, **model_state}, images, train=True,
             mutable=["batch_stats"],
         )
         if label_smoothing > 0:
@@ -183,10 +189,11 @@ def make_loss_fn(model, weight_decay=1e-4, label_smoothing=0.0):
     return loss_fn
 
 
-def make_predict_fn(model):
+def make_predict_fn(model, normalize=None):
     def predict_fn(params, model_state, batch):
+        images = batch["image"] if normalize is None else normalize(batch["image"])
         logits = model.apply(
-            {"params": params, **model_state}, batch["image"], train=False
+            {"params": params, **model_state}, images, train=False
         )
         return jnp.argmax(logits, -1)
 

@@ -58,11 +58,27 @@ def makedirs(path):
 
 
 def rename(src, dst):
-    """Atomic-ish same-filesystem rename (the shard commit step)."""
+    """Same-filesystem rename (the shard commit step).
+
+    Local paths get a true atomic ``os.replace``. On fsspec URIs ``mv`` is
+    copy+delete on object stores; if it refuses because the destination
+    already exists, a racing speculative/retried committer won — its shard
+    is equivalent (same deterministic partition), so the existing file is
+    kept and the temp file dropped. The existing destination is never
+    deleted first: that would open a window where a committed shard is gone
+    and no task remains to rewrite it."""
     if is_uri(src):
         fs, s = _fs(src)
         _fs2, d = _fs(dst)
-        fs.mv(s, d)
+        try:
+            fs.mv(s, d)
+        except Exception:
+            if not fs.exists(d):
+                raise
+            try:
+                fs.rm(s)
+            except Exception:
+                pass  # stray temp file; harmless to shard listing
     else:
         os.replace(src, dst)
 

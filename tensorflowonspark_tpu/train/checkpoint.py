@@ -78,12 +78,27 @@ def restore_checkpoint(path, target=None):
     """Restore a pytree from ``path``; ``target`` gives structure/shardings."""
     path = os.path.abspath(os.path.expanduser(path))
     ckptr = _checkpointer()
-    saveable_target = _to_saveable(target) if target is not None else None
-    state = (
-        ckptr.restore(path, saveable_target)
-        if saveable_target is not None
-        else ckptr.restore(path)
-    )
+    if target is None:
+        state = ckptr.restore(path)
+    else:
+        try:
+            state = ckptr.restore(path, _to_saveable(target))
+        except Exception as targeted_err:
+            # checkpoints written before model_state was always included
+            # mismatch the target's tree structure; retry with the OLD
+            # layout as the target (keeping every other leaf's sharding).
+            # Any other failure re-raises the original error.
+            old_target = _to_saveable(target)
+            if not (isinstance(old_target, dict) and "model_state" in old_target):
+                raise
+            old_target = {k: v for k, v in old_target.items() if k != "model_state"}
+            try:
+                state = ckptr.restore(path, old_target)
+            except Exception:
+                raise targeted_err
+            logger.warning(
+                "restored pre-model_state checkpoint layout from %s", path
+            )
     logger.info("restored checkpoint from %s", path)
     return _from_saved(state, target)
 

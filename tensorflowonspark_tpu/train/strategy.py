@@ -122,25 +122,6 @@ class SyncDataParallel:
     def shard_batch(self, batch):
         return shard_batch(batch, self.mesh)
 
-    def shard_stacked_batches(self, stacked):
-        """Place a ``[K, batch, ...]`` per-step batch stack for
-        :meth:`compile_train_loop`: the leading step axis stays unsharded,
-        the batch axis shards over the data axes. Multi-process: each
-        process contributes its local ``[K, local_batch, ...]`` stack."""
-        import jax as _jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from tensorflowonspark_tpu.parallel.sharding import data_axes
-
-        axes = data_axes(self.mesh)
-        spec = P(None, (axes if len(axes) > 1 else axes[0]) if axes else None)
-        sharding = NamedSharding(self.mesh, spec)
-        if _jax.process_count() == 1:
-            return _jax.tree.map(lambda x: _jax.device_put(x, sharding), stacked)
-        return _jax.tree.map(
-            lambda x: _jax.make_array_from_process_local_data(sharding, x), stacked
-        )
-
     # -- state ----------------------------------------------------------------
 
     @staticmethod
@@ -265,42 +246,53 @@ class SyncDataParallel:
         return jax.jit(step, donate_argnums=(0,) if donate else ())
 
     def compile_train_loop(self, loss_fn, optimizer, num_steps, has_aux=False, mutable=False, donate=True):
-        """Compile ``loop(state, stacked_batches) -> (state, last_metrics)``
-        running ``num_steps`` train steps INSIDE one XLA program via
-        ``lax.scan``.
+        """Compile ``loop(state, batches) -> (state, last_metrics)`` running
+        ``num_steps`` train steps INSIDE one XLA program via ``lax.scan``.
 
-        ``stacked_batches`` is the per-step batch pytree with a leading
-        ``num_steps`` dim — stack host batches with ``np.stack`` and place
-        them with :meth:`shard_stacked_batches` (batch axis sharded over the
-        data axes, step axis whole). One device dispatch per ``num_steps``
-        steps: on remote/tunneled TPU runtimes the per-dispatch host round
-        trip is milliseconds — at small step times it dominates, and
-        scanning it away is the difference between host-bound and MXU-bound
-        training (no reference analogue: TF sessions had the same per-step
-        host loop this removes).
+        ``batches`` is a list/tuple of ``num_steps`` per-step batch pytrees,
+        each already device-resident via :meth:`shard_batch` — place them as
+        they arrive from the feed so the host→device transfers run
+        asynchronously, overlapping the previous loop's compute (see
+        :func:`tensorflowonspark_tpu.data.loop_prefetch`). The stack into the
+        scan's ``[K, batch, ...]`` carry happens ON DEVICE (an HBM-to-HBM
+        copy XLA aliases away under donation), never on the host: a host-side
+        ``np.stack`` + one bulk transfer sits on the critical path and loses
+        to per-step dispatch, which is why this API takes device arrays.
+
+        One device dispatch per ``num_steps`` steps: on remote/tunneled TPU
+        runtimes the per-dispatch host round trip is milliseconds — at small
+        step times it dominates, and scanning it away is the difference
+        between host-bound and MXU-bound training (no reference analogue: TF
+        sessions had the same per-step host loop this removes).
+
+        With ``donate=True`` (default) both the state and the batch list are
+        donated — treat the passed batches as consumed. ``donate="state"``
+        donates only the state (for callers that re-feed the same device
+        batches, e.g. synthetic-input benchmarks).
         """
         step = self.compile_train_step(
             loss_fn, optimizer, has_aux=has_aux, mutable=mutable, donate=False
         )
 
-        def loop(state, stacked_batches):
-            lead = {jax.tree.leaves(stacked_batches)[0].shape[0]}
-            if lead != {num_steps}:
+        def loop(state, batches):
+            if len(batches) != num_steps:
                 raise ValueError(
-                    "stacked_batches has {} steps, loop compiled for {}".format(
-                        lead.pop(), num_steps
+                    "got {} batches, loop compiled for {}".format(
+                        len(batches), num_steps
                     )
                 )
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
 
             def body(carry, batch):
                 new_state, metrics = step(carry, batch)
                 return new_state, metrics
 
-            state, metrics = jax.lax.scan(body, state, stacked_batches)
+            state, metrics = jax.lax.scan(body, state, stacked)
             # metrics of the LAST step (scan stacks them; take index -1)
             return state, jax.tree.map(lambda m: m[-1], metrics)
 
-        return jax.jit(loop, donate_argnums=(0,) if donate else ())
+        donate_argnums = {True: (0, 1), "state": (0,), False: ()}[donate]
+        return jax.jit(loop, donate_argnums=donate_argnums)
 
     def compile_eval_step(self, metric_fn):
         """Compile ``metric_fn(params, batch) -> metrics`` for sharded eval."""

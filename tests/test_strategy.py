@@ -137,29 +137,77 @@ def test_compile_train_loop_matches_sequential_steps():
     opt = optax.sgd(0.1)
     rng = np.random.default_rng(0)
     K = 4
-    batches = {
-        "image": rng.standard_normal((K, 16, 28, 28)).astype(np.float32),
-        "label": rng.integers(0, 10, (K, 16)),
-    }
+    host_batches = [
+        {
+            "image": rng.standard_normal((16, 28, 28)).astype(np.float32),
+            "label": rng.integers(0, 10, 16),
+        }
+        for _ in range(K)
+    ]
 
     state_a = strategy.create_state(mnist.make_init_fn(model), opt, jax.random.PRNGKey(0))
     loop = strategy.compile_train_loop(mnist.make_loss_fn(model), opt, K, has_aux=True, donate=False)
-    state_a, metrics = loop(state_a, strategy.shard_stacked_batches(batches))
+    device_batches = [strategy.shard_batch(b) for b in host_batches]
+    state_a, metrics = loop(state_a, device_batches)
     jax.block_until_ready(metrics["loss"])
-    # step-count mismatch is a loud error, not a silent shorter run
+    # batch-count mismatch is a loud error, not a silent shorter run
     import pytest as _pytest
 
-    with _pytest.raises(ValueError, match="steps"):
-        bad = {name: vals[:2] for name, vals in batches.items()}
-        loop(state_a, strategy.shard_stacked_batches(bad))
+    with _pytest.raises(ValueError, match="batches"):
+        loop(state_a, device_batches[:2])
 
     state_b = strategy.create_state(mnist.make_init_fn(model), opt, jax.random.PRNGKey(0))
     step = strategy.compile_train_step(mnist.make_loss_fn(model), opt, has_aux=True, donate=False)
-    for k in range(K):
-        batch = {name: vals[k] for name, vals in batches.items()}
+    for batch in host_batches:
         state_b, m = step(state_b, strategy.shard_batch(batch))
         jax.block_until_ready(m["loss"])
 
     np.testing.assert_allclose(float(metrics["loss"]), float(m["loss"]), rtol=1e-5)
     for a, b in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_loop_prefetch_windows_and_drops_remainder():
+    from tensorflowonspark_tpu.data import loop_prefetch
+
+    mesh = parallel.build_mesh({"dp": 8})
+    strategy = SyncDataParallel(mesh)
+    rng = np.random.default_rng(0)
+    host = [{"x": rng.standard_normal((8, 2)).astype(np.float32)} for _ in range(10)]
+    windows = list(loop_prefetch(iter(host), strategy, num_steps=4))
+    # 10 batches -> two full windows of 4; the short remainder is dropped
+    assert [len(w) for w in windows] == [4, 4]
+    flat = [b for w in windows for b in w]
+    for got, want in zip(flat, host[:8]):
+        np.testing.assert_allclose(np.asarray(got["x"]), want["x"])
+
+
+def test_restore_checkpoint_tolerates_missing_model_state(tmp_path):
+    """A checkpoint saved WITHOUT model_state (pre-r2 layout) still restores
+    into a TrainState target (falls back to a target-less restore)."""
+    import orbax.checkpoint as ocp
+
+    from tensorflowonspark_tpu.train import checkpoint
+
+    mesh = parallel.build_mesh({"dp": 8})
+    strategy = SyncDataParallel(mesh)
+    optimizer = optax.sgd(0.1)
+    state = strategy.create_state(_linear_init, optimizer, jax.random.PRNGKey(0))
+
+    old_layout = {
+        "__train_state__": 1,
+        "step": np.asarray(jax.device_get(state.step)),
+        "params": jax.device_get(state.params),
+        "opt_state": jax.device_get(state.opt_state),
+    }
+    path = str(tmp_path / "old_ckpt")
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, old_layout)
+    ckptr.wait_until_finished()
+
+    restored = checkpoint.restore_checkpoint(path, target=jax.device_get(state))
+    assert isinstance(restored, TrainState)
+    assert restored.model_state == {}
+    np.testing.assert_allclose(
+        np.asarray(restored.params["w"]), np.asarray(jax.device_get(state.params["w"]))
+    )
