@@ -62,15 +62,33 @@ def main_fun(args, ctx):
         model, image_size, classes = resnet.resnet56(dtype=dtype), 32, 10
     else:
         model, image_size, classes = resnet.resnet50(dtype=dtype), 224, 1000
+    if args.image_size:
+        image_size = args.image_size
+    use_real = bool(args.data_dir) and not args.use_synthetic_data
+    # imagenet real data feeds raw uint8 (quarter the host->device bytes);
+    # the mean subtraction fuses into the first conv on device
+    feed_uint8 = use_real and args.dataset == "imagenet"
     optimizer = optax.sgd(lr_schedule(args), momentum=0.9)
     state = strategy.create_state(
         resnet.make_init_fn(model, image_size=image_size), optimizer, jax.random.PRNGKey(0)
     )
-    step = strategy.compile_train_step(
-        resnet.make_loss_fn(model, weight_decay=1e-4), optimizer, mutable=True
-    )
+    from tensorflowonspark_tpu.data import imagenet as imagenet_mod
 
-    if args.data_dir and not args.use_synthetic_data:
+    loss_fn = resnet.make_loss_fn(
+        model, weight_decay=1e-4,
+        normalize=imagenet_mod.device_normalize if feed_uint8 else None,
+    )
+    steps_per_loop = max(int(getattr(args, "steps_per_loop", 1) or 1), 1)
+    if steps_per_loop > 1:
+        # K steps fused into one lax.scan dispatch; transfers overlap compute.
+        # The synthetic path re-feeds one device batch, so only donate state.
+        loop = strategy.compile_train_loop(
+            loss_fn, optimizer, steps_per_loop, mutable=True,
+            donate=True if use_real else "state",
+        )
+    step = strategy.compile_train_step(loss_fn, optimizer, mutable=True)
+
+    if use_real:
         # REAL data: per-worker file shards → threaded decode/augment →
         # device double-buffering (InputMode.TENSORFLOW per-worker sharding,
         # reference mnist_inference.py:42 ds.shard + input_fn)
@@ -94,7 +112,8 @@ def main_fun(args, ctx):
             cifar_data.make_parse_fn(True, seed=ctx.executor_id)
             if args.dataset == "cifar"
             else imagenet_data.make_parse_fn(
-                True, image_size=image_size, label_offset=args.label_offset, seed=ctx.executor_id
+                True, image_size=image_size, label_offset=args.label_offset,
+                seed=ctx.executor_id, raw_uint8=feed_uint8,
             )
         )
         pipe = ImagePipeline(
@@ -113,16 +132,21 @@ def main_fun(args, ctx):
         batches = iter(lambda: synthetic, None)  # repeat forever
 
     t0, metrics = time.perf_counter(), {}
-    for i in range(args.train_steps):
-        batch = next(batches)
-        state, metrics = step(state, batch)
-        if (i + 1) % args.log_steps == 0:
+    i = last_log = 0
+    while i < args.train_steps:
+        if steps_per_loop > 1 and i + steps_per_loop <= args.train_steps:
+            state, metrics = loop(state, [next(batches) for _ in range(steps_per_loop)])
+            i += steps_per_loop
+        else:
+            state, metrics = step(state, next(batches))
+            i += 1
+        if i - last_log >= args.log_steps:
             jax.block_until_ready(metrics["loss"])
             dt = time.perf_counter() - t0
             # avg_exp_per_second analogue (reference common.py:241-244)
             print("step {}: loss {:.3f} {:.1f} img/s".format(
-                i + 1, float(metrics["loss"]), args.batch_size * args.log_steps / dt))
-            t0 = time.perf_counter()
+                i, float(metrics["loss"]), args.batch_size * (i - last_log) / dt))
+            last_log, t0 = i, time.perf_counter()
     if metrics:
         jax.block_until_ready(metrics["loss"])
         print("final loss {:.3f}".format(float(metrics["loss"])))
@@ -143,9 +167,14 @@ def main(argv=None):
     parser.add_argument("--data_threads", type=int, default=8)
     parser.add_argument("--dataset", choices=["cifar", "imagenet"], default="cifar")
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16")
+    parser.add_argument("--image_size", type=int, default=None,
+                        help="override the dataset's native size (tests/CI)")
     parser.add_argument("--label_offset", type=int, default=0,
                         help="-1 for 1-based ImageNet labels")
     parser.add_argument("--log_steps", type=int, default=20)
+    parser.add_argument("--steps_per_loop", type=int, default=1,
+                        help=">1 fuses that many train steps into one device "
+                             "dispatch (lax.scan)")
     parser.add_argument("--model_dir", default=None)
     parser.add_argument("--steps_per_epoch", type=int, default=390)
     parser.add_argument("--train_steps", type=int, default=100)

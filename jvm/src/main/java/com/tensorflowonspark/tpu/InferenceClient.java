@@ -108,6 +108,81 @@ public final class InferenceClient implements Closeable {
     return parse2d(outputs.substring(bracket, matchBracket(outputs, bracket) + 1));
   }
 
+  /**
+   * Binary tensor lane for one float32 2-D input column (see jvm/README.md):
+   * JSON header frame + one raw little-endian frame each way — no JSON text
+   * encoding of the payloads. Returns the first output column as rows.
+   */
+  public float[][] predictBinary(String column, float[][] batch) throws IOException {
+    int rows = batch.length;
+    int cols = rows == 0 ? 0 : batch[0].length;
+    String header = "{\"type\": \"predict_binary\", \"columns\": [{\"name\": \""
+        + column + "\", \"dtype\": \"<f4\", \"shape\": [" + rows + ", " + cols + "]}]}";
+    byte[] hb = header.getBytes(StandardCharsets.UTF_8);
+    out.writeInt(hb.length);
+    out.write(hb);
+    java.nio.ByteBuffer payload = java.nio.ByteBuffer
+        .allocate(rows * cols * 4).order(java.nio.ByteOrder.LITTLE_ENDIAN);
+    for (float[] row : batch) {
+      if (row.length != cols) throw new IllegalArgumentException("ragged batch");
+      for (float v : row) payload.putFloat(v);
+    }
+    out.writeInt(payload.capacity());
+    out.write(payload.array());
+    out.flush();
+
+    int length = in.readInt();
+    if (length < 0 || length > (64 << 20)) throw new IOException("bad reply length " + length);
+    byte[] reply = new byte[length];
+    in.readFully(reply);
+    String text = new String(reply, StandardCharsets.UTF_8);
+    String type = topLevelType(text);
+    if ("error".equals(type)) throw new IOException("server error: " + text);
+    if (!"result_binary".equals(type)) throw new IOException("unexpected reply: " + text);
+    // first column's dtype + shape (fixed message shape; minimal parsing)
+    String dtype = extractString(text, "\"dtype\"");
+    int[] shape = extract2dShape(text);
+    int blen = in.readInt();
+    if (blen < 0) throw new IOException("bad binary frame length " + blen);
+    byte[] raw = new byte[blen];
+    in.readFully(raw);
+    java.nio.ByteBuffer buf =
+        java.nio.ByteBuffer.wrap(raw).order(java.nio.ByteOrder.LITTLE_ENDIAN);
+    float[][] result = new float[shape[0]][shape[1]];
+    boolean f8 = "<f8".equals(dtype);
+    if (!f8 && !"<f4".equals(dtype)) throw new IOException("unsupported output dtype " + dtype);
+    for (int r = 0; r < shape[0]; r++) {
+      for (int c = 0; c < shape[1]; c++) {
+        result[r][c] = f8 ? (float) buf.getDouble() : buf.getFloat();
+      }
+    }
+    return result;
+  }
+
+  static String extractString(String s, String key) throws IOException {
+    int i = s.indexOf(key);
+    if (i < 0) throw new IOException("missing " + key + " in: " + s);
+    int start = s.indexOf('"', s.indexOf(':', i) + 1);
+    int end = s.indexOf('"', start + 1);
+    return s.substring(start + 1, end);
+  }
+
+  static int[] extract2dShape(String s) throws IOException {
+    int i = s.indexOf("\"shape\"");
+    if (i < 0) throw new IOException("missing shape in: " + s);
+    int open = s.indexOf('[', i);
+    int close = s.indexOf(']', open);
+    String[] parts = s.substring(open + 1, close).split(",");
+    if (parts.length == 1) {  // 1-D output: treat as [rows, 1]
+      return new int[] {Integer.parseInt(parts[0].trim()), 1};
+    }
+    if (parts.length > 2) {  // never truncate silently; use predictRaw for N-D
+      throw new IOException("predictBinary supports 1-D/2-D outputs; got shape "
+          + s.substring(open, close + 1));
+    }
+    return new int[] {Integer.parseInt(parts[0].trim()), Integer.parseInt(parts[1].trim())};
+  }
+
   @Override
   public void close() throws IOException {
     socket.close();

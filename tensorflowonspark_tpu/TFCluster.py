@@ -17,6 +17,7 @@ TPU-native differences (SURVEY.md §7):
 """
 
 import logging
+import os
 import random
 import secrets
 import threading
@@ -47,6 +48,95 @@ class TFCluster:
         self.num_workers = num_workers
         self.worker_executor_ids = worker_executor_ids
         self.queues = cluster_meta["queues"]
+        self._monitor_stop = None
+        self._start_monitor()
+
+    # -- failure watchdog ------------------------------------------------------
+
+    def _start_monitor(self, interval=None, stale_secs=None):
+        """Driver-side watchdog: polls every node channel so a crashed child
+        surfaces within seconds, not at shutdown (VERDICT r2 item 7; the
+        reference only polled error queues from feed tasks and at teardown,
+        TFCluster.py:136-144,178-183).
+
+        Two signals per node: (a) the error queue (peeked non-destructively —
+        a posted traceback stays visible to the shutdown path), (b) the
+        child heartbeat counter — a child that dies without posting (SIGKILL,
+        OOM) stops beating and is flagged after ``stale_secs`` without
+        progress. Findings land in ``tf_status`` (checked by feeders, the
+        shutdown join loop, and :meth:`check_errors`).
+        """
+        import threading
+        import time as _time
+
+        interval = interval or float(os.environ.get("TOS_MONITOR_INTERVAL", "3"))
+        stale_secs = stale_secs or float(os.environ.get("TOS_HEARTBEAT_STALE", "30"))
+        stop = threading.Event()
+        self._monitor_stop = stop
+        last_beat = {}  # executor_id -> (value, local time it changed)
+        channels = {}
+
+        def _poll_node(row):
+            import socket as _socket
+
+            key = row["executor_id"]
+            mgr = channels.get(key)
+            if mgr is None:
+                # cheap bounded reachability probe first: BaseManager.connect
+                # has no timeout, and one unreachable (NAT'd) node must not
+                # stall the single monitor thread for the OS connect timeout
+                # every cycle
+                addr = tuple(row["manager_addr"])
+                with _socket.create_connection(addr, timeout=2):
+                    pass
+                mgr = TFManager.connect(addr, self.cluster_meta["authkey"])
+                channels[key] = mgr
+            tb = TFSparkNode.peek_error(mgr)
+            if tb is not None:
+                return "node {}:{} failed:\n{}".format(row["job_name"], row["task_index"], tb)
+            status = mgr.get("child_status")
+            if status is not None:
+                last_beat.pop(key, None)  # exited cleanly/already reported
+                return None
+            beat = mgr.get("heartbeat")
+            if beat is None:
+                return None  # child not up yet
+            prev = last_beat.get(key)
+            now = _time.monotonic()
+            if prev is None or prev[0] != beat:
+                last_beat[key] = (beat, now)
+                return None
+            if now - prev[1] > stale_secs:
+                return (
+                    "node {}:{} stopped heartbeating for {:.0f}s without a "
+                    "final status (child killed?)".format(
+                        row["job_name"], row["task_index"], now - prev[1]
+                    )
+                )
+            return None
+
+        def _monitor():
+            reported = set()
+            while not stop.wait(interval):
+                for row in self.cluster_info or []:
+                    if not row.get("manager_addr") or row["executor_id"] in reported:
+                        continue
+                    try:
+                        problem = _poll_node(row)
+                    except Exception:
+                        continue  # channel unreachable: shutdown's concern
+                    if problem:
+                        reported.add(row["executor_id"])
+                        logger.error("watchdog: %s", problem)
+                        self.tf_status.setdefault("error", problem)
+
+        threading.Thread(target=_monitor, name="tos-watchdog", daemon=True).start()
+
+    def check_errors(self):
+        """Raise if the watchdog (or the launch path) recorded a node
+        failure; cheap enough to call between training epochs."""
+        if self.tf_status.get("error"):
+            raise RuntimeError("cluster failed: {}".format(self.tf_status["error"]))
 
     # -- data plane -----------------------------------------------------------
 
@@ -169,9 +259,19 @@ class TFCluster:
                             "could not stop %s:%s at %s: %s",
                             row["job_name"], row["task_index"], row["manager_addr"], e,
                         )
-            self.launch_thread.join(timeout=timeout)
+            # poll-join so a watchdog-detected node failure cuts the wait
+            # short instead of riding out the full timeout
+            import time as _time
+
+            deadline = _time.time() + timeout
+            while self.launch_thread.is_alive() and _time.time() < deadline:
+                self.launch_thread.join(timeout=1.0)
+                if self.tf_status.get("error"):
+                    break
             self.server.stop()
-        if self.launch_thread.is_alive():
+            if self._monitor_stop is not None:
+                self._monitor_stop.set()
+        if self.launch_thread.is_alive() and not self.tf_status.get("error"):
             raise RuntimeError("cluster did not shut down within {}s".format(timeout))
         if self.tf_status.get("error"):
             raise RuntimeError(
@@ -195,6 +295,13 @@ class TFCluster:
         scheduler spreading exactly one quick task per executor; here every
         worker is addressed explicitly, so no node can miss (or double-get)
         its end-of-feed marker.
+
+        When a worker's channel is NOT reachable from the driver (NAT'd real
+        clusters: executor TCP ports are often driver-opaque), shutdown falls
+        back to the reference's design — one
+        :class:`~tensorflowonspark_tpu.TFSparkNode._ShutdownPartitionTask`
+        scattered per executor, each posting end-of-feed over its own
+        executor-local channel.
         """
         import time
 
@@ -203,6 +310,7 @@ class TFCluster:
             if r["job_name"] in ("chief", "master", "worker") and r.get("manager_addr")
         ]
         channels = []
+        unreachable = []
         for row in workers:
             try:
                 mgr = TFManager.connect(tuple(row["manager_addr"]), self.cluster_meta["authkey"])
@@ -212,6 +320,9 @@ class TFCluster:
                 logger.warning(
                     "could not reach %s:%s for shutdown: %s", row["job_name"], row["task_index"], e
                 )
+                unreachable.append(row)
+        if unreachable:
+            self._shutdown_by_spark_tasks(grace_secs, unreachable)
         errors = []
         deadline = time.time() + max(grace_secs, 60)
         for row, mgr in channels:
@@ -232,6 +343,29 @@ class TFCluster:
             mgr.set("state", "stopped")
         if errors:
             raise RuntimeError("error(s) in cluster nodes:\n" + "\n".join(errors))
+
+    def _shutdown_by_spark_tasks(self, grace_secs, rows):
+        """Reference-style shutdown scatter (TFCluster.py:174-176): one Spark
+        task per executor posts end-of-feed over the executor-LOCAL channel —
+        the path that still works when executor TCP is unreachable from the
+        driver. Tasks landing on already-stopped nodes are no-ops (an extra
+        end-of-feed marker in a drained queue)."""
+        logger.warning(
+            "falling back to Spark-task shutdown for %d unreachable worker(s): %s",
+            len(rows),
+            ", ".join("{}:{}".format(r["job_name"], r["task_index"]) for r in rows),
+        )
+        n = max(self.num_workers, len(rows))
+        try:
+            # local backend: pin task i to executor i so every node gets its
+            # marker; pyspark lacks the kwarg and relies on the scheduler
+            # spreading quick tasks (the reference's assumption)
+            shutdown_rdd = self.sc.parallelize(range(n), n, pin_to_executors=True)
+        except TypeError:
+            shutdown_rdd = self.sc.parallelize(range(n), n)
+        shutdown_rdd.foreachPartition(
+            TFSparkNode.shutdown(self.cluster_info, self.cluster_meta, grace_secs=grace_secs)
+        )
 
     # -- observability --------------------------------------------------------
 
@@ -347,6 +481,10 @@ def run(
         "jax_distributed": bool(jax_distributed),
         "tensorboard": bool(tensorboard),
         "log_dir": log_dir,
+        # the driver's feed-lane choice, honored on BOTH halves of the plane
+        # (feed tasks capture it at construction; DataFeed.batch_results
+        # reads it from ctx.cluster_meta)
+        "feed_shm": TFSparkNode.FEED_SHM,
     }
 
     tf_status = {}

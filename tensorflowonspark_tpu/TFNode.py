@@ -21,6 +21,27 @@ from tensorflowonspark_tpu.marker import Chunk, EndPartition
 
 logger = logging.getLogger(__name__)
 
+
+def _is_shm_chunk(item):
+    """Type check without importing numpy/shm on the common path."""
+    from tensorflowonspark_tpu.shm import ShmChunk
+
+    return isinstance(item, ShmChunk)
+
+
+def _all_numpy(rows):
+    """True when every row (and every field of tuple rows) is a numpy value —
+    the precondition for type-faithful shared-memory results."""
+    import numpy as np
+
+    def _np(v):
+        return isinstance(v, (np.ndarray, np.generic))
+
+    return bool(rows) and all(
+        all(_np(f) for f in r) if isinstance(r, (tuple, list)) else _np(r)
+        for r in rows
+    )
+
 #: URI schemes recognized as absolute filesystem locations
 #: (reference TFNode.py:40-49, plus ``gs`` as a first-class TPU-era scheme).
 _FS_SCHEMES = (
@@ -101,12 +122,20 @@ class DataFeed:
       input column order (TFNode.py:261,281-286).
     """
 
-    def __init__(self, mgr, train_mode=True, qname_in="input", qname_out="output", input_mapping=None):
+    def __init__(self, mgr, train_mode=True, qname_in="input", qname_out="output", input_mapping=None, use_shm=None):
+        import os
+
         self.mgr = mgr
         self.train_mode = train_mode
         self.qname_in = qname_in
         self.qname_out = qname_out
         self.done_feeding = False
+        #: output-lane shared-memory gate: the driver's choice arrives via
+        #: ctx.get_data_feed (cluster_meta["feed_shm"]); standalone DataFeeds
+        #: fall back to this process's env
+        self.use_shm = (
+            os.environ.get("TOS_FEED_SHM", "1") == "1" if use_shm is None else bool(use_shm)
+        )
         self.input_tensors = (
             [input_mapping[col] for col in sorted(input_mapping)] if input_mapping else None
         )
@@ -160,9 +189,13 @@ class DataFeed:
                 queue_in.task_done()
                 if count > 0:
                     break
-            elif isinstance(item, Chunk):
-                # task_done deferred until the last row is consumed
-                self._pending.extend(item.items)
+            elif isinstance(item, Chunk) or _is_shm_chunk(item):
+                # pickled chunk or shared-memory descriptor (the latter's
+                # payload never crossed the Manager socket: rows() is a
+                # materialize-memcpy + unlink); either way task_done is
+                # deferred until the last row is consumed
+                rows = item.items if isinstance(item, Chunk) else item.rows()
+                self._pending.extend(rows)
                 self._chunk_open = bool(self._pending)
                 if not self._pending:  # defensive: empty chunk
                     queue_in.task_done()
@@ -186,7 +219,21 @@ class DataFeed:
     def batch_results(self, results):
         """Push a batch of inference results to the output queue — one
         chunked message per call; the contract stays 1:1 row-for-row with
-        consumed inputs (reference TFNode.py:294-305)."""
+        consumed inputs (reference TFNode.py:294-305). Uniform numeric
+        results ride the shared-memory lane like the input feed."""
+        results = list(results)
+        if self.use_shm and _all_numpy(results):
+            # numpy-only gate: shm materialization yields numpy values, so
+            # only rows that are ALREADY numpy keep their exact types across
+            # the lane; Python ints/floats/lists take the pickled path
+            # (collectors would otherwise see np types, breaking e.g.
+            # json.dumps of collected rows)
+            from tensorflowonspark_tpu.shm import ShmChunk
+
+            chunk = ShmChunk.from_rows(results)
+            if chunk is not None:
+                self.mgr.get_queue(self.qname_out).put(chunk, block=True)
+                return
         self.mgr.get_queue(self.qname_out).put(Chunk(results), block=True)
 
     def terminate(self):
@@ -202,7 +249,9 @@ class DataFeed:
         empty_checks = 0
         while empty_checks < 3:
             try:
-                queue_in.get_nowait()
+                item = queue_in.get_nowait()
+                if _is_shm_chunk(item):
+                    item.discard()  # unlink the unread segment
                 queue_in.task_done()
                 empty_checks = 0
             except Exception:

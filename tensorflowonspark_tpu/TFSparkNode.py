@@ -35,6 +35,24 @@ from tensorflowonspark_tpu.marker import Chunk, EndPartition
 #: huge rows via env)
 FEED_CHUNK_SIZE = int(os.environ.get("TOS_FEED_CHUNK", "100"))
 
+#: ship chunk payloads through shared memory (columnar numpy segments; the
+#: Manager carries only descriptors) — rows without a uniform numeric shape
+#: fall back to pickled Chunks per chunk; TOS_FEED_SHM=0 disables the lane
+FEED_SHM = os.environ.get("TOS_FEED_SHM", "1") == "1"
+
+
+def _put_rows(q, rows, use_shm=None):
+    """One feed-plane message: shared-memory columnar segment when the rows
+    allow it, pickled Chunk otherwise."""
+    if FEED_SHM if use_shm is None else use_shm:
+        from tensorflowonspark_tpu.shm import ShmChunk
+
+        chunk = ShmChunk.from_rows(rows)
+        if chunk is not None:
+            q.put(chunk, block=True)
+            return
+    q.put(Chunk(rows), block=True)
+
 logger = logging.getLogger(__name__)
 
 #: Executor-process-global registry of live IPC channels, keyed by executor id.
@@ -100,7 +118,10 @@ class TFNodeContext:
 
     def get_data_feed(self, train_mode=True, qname_in="input", qname_out="output", input_mapping=None):
         """The InputMode.SPARK consumer (reference TFNode.py:221)."""
-        return TFNode.DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping)
+        return TFNode.DataFeed(
+            self.mgr, train_mode, qname_in, qname_out, input_mapping,
+            use_shm=self.cluster_meta.get("feed_shm"),
+        )
 
     def absolute_path(self, path):
         return TFNode.hdfs_path(self, path)
@@ -186,6 +207,7 @@ def _child_entry(fn, tf_args, ctx, cluster_meta, error_queue_spec):
         # re-connect our own IPC channel from inside the child
         addr, authkey = error_queue_spec
         ctx.mgr = TFManager.connect(addr, authkey)
+        _start_heartbeat(ctx.mgr)
         if cluster_meta.get("jax_distributed", True):
             ctx.initialize_distributed()
         if cluster_meta.get("log_dir") and ctx.process_id == 0:
@@ -210,6 +232,37 @@ def _child_entry(fn, tf_args, ctx, cluster_meta, error_queue_spec):
         except Exception:
             pass
         raise SystemExit(1)
+
+
+#: seconds between child heartbeats on the IPC channel (the driver-side
+#: monitor flags a node whose beat stops without a final child_status —
+#: e.g. a SIGKILLed jax child that could post no traceback)
+HEARTBEAT_INTERVAL = float(os.environ.get("TOS_HEARTBEAT_INTERVAL", "2"))
+
+
+def _start_heartbeat(mgr):
+    """Daemon thread bumping a counter on the channel every
+    HEARTBEAT_INTERVAL; exits quietly when the channel goes away."""
+    import threading
+
+    def _beat():
+        n = 0
+        failures = 0
+        while True:
+            try:
+                mgr.set("heartbeat", n)
+                failures = 0
+            except Exception:
+                # transient proxy hiccups must not kill the beat (the
+                # watchdog would then fail a healthy node); only a channel
+                # that stays dead ends the thread
+                failures += 1
+                if failures >= 5:
+                    return
+            n += 1
+            time.sleep(HEARTBEAT_INTERVAL)
+
+    threading.Thread(target=_beat, name="tos-heartbeat", daemon=True).start()
 
 
 class _NodeLaunchTask:
@@ -327,7 +380,11 @@ class _NodeLaunchTask:
             num_processes=num_procs if meta.get("jax_distributed", False) else 1,
             process_id=proc_id,
             topology=tpu_info.local_topology(),
-            cluster_meta={k: meta[k] for k in ("id", "server_addr", "input_mode") if k in meta},
+            cluster_meta={
+                k: meta[k]
+                for k in ("id", "server_addr", "input_mode", "feed_shm")
+                if k in meta
+            },
         )
         mgr.set("state", "running")
         logger.info(
@@ -484,6 +541,9 @@ class _TrainPartitionTask:
         self.qname = qname
         self.feed_timeout = feed_timeout
         self.chunk_size = chunk_size or FEED_CHUNK_SIZE
+        # captured at task construction (driver side) so the executor honors
+        # the driver's setting regardless of its own env
+        self.use_shm = FEED_SHM
 
     def __call__(self, iterator):
         _state, mgr = _connect_executor_channel()
@@ -499,12 +559,16 @@ class _TrainPartitionTask:
             buf.append(item)
             count += 1
             if len(buf) >= self.chunk_size:
-                q.put(Chunk(buf), block=True)
+                _put_rows(q, buf, self.use_shm)
                 buf = []
         if buf:
-            q.put(Chunk(buf), block=True)
+            _put_rows(q, buf, self.use_shm)
         logger.info("fed %d items to queue %r; waiting for consumption", count, self.qname)
         deadline = time.time() + self.feed_timeout
+        # fine-grained poll at first (a consumer already caught up finishes
+        # the wait in ~ms, which matters for many small partitions), backing
+        # off so long waits don't hammer the proxy
+        poll = 0.002
         while q.unfinished() > 0:
             _raise_if_remote_error(mgr)
             if mgr.get("state") == "terminating":
@@ -515,7 +579,8 @@ class _TrainPartitionTask:
                         self.qname, q.unfinished()
                     )
                 )
-            time.sleep(0.1)
+            time.sleep(poll)
+            poll = min(poll * 2, 0.1)
         _raise_if_remote_error(mgr)
         if mgr.get("state") == "terminating":
             # training said "enough" (e.g. reached target steps): tell the
@@ -545,6 +610,7 @@ class _InferencePartitionTask:
         self.qname_out = qname_out
         self.feed_timeout = feed_timeout
         self.chunk_size = chunk_size or FEED_CHUNK_SIZE
+        self.use_shm = FEED_SHM
 
     def __call__(self, iterator):
         _state, mgr = _connect_executor_channel()
@@ -555,25 +621,31 @@ class _InferencePartitionTask:
             buf.append(item)
             count += 1
             if len(buf) >= self.chunk_size:
-                q.put(Chunk(buf), block=True)
+                _put_rows(q, buf, self.use_shm)
                 buf = []
         if buf:
-            q.put(Chunk(buf), block=True)
+            _put_rows(q, buf, self.use_shm)
         q.put(EndPartition(), block=True)
         if count == 0:
             return []
         deadline = time.time() + self.feed_timeout
+        poll = 0.002
         while q.unfinished() > 0:
             _raise_if_remote_error(mgr)
             if time.time() > deadline:
                 raise RuntimeError("inference feed timeout on queue {!r}".format(self.qname_in))
-            time.sleep(0.1)
+            time.sleep(poll)
+            poll = min(poll * 2, 0.1)
+        from tensorflowonspark_tpu.shm import ShmChunk
+
         out = mgr.get_queue(self.qname_out)
         results = []
         while len(results) < count:
             item = out.get(block=True, timeout=self.feed_timeout)
             out.task_done()
-            if isinstance(item, Chunk):
+            if isinstance(item, ShmChunk):
+                results.extend(item.rows())
+            elif isinstance(item, Chunk):
                 results.extend(item.items)
             else:
                 results.append(item)
@@ -619,6 +691,12 @@ class _ShutdownPartitionTask:
             time.sleep(self.grace_secs)
         _raise_if_remote_error(mgr)
         mgr.set("state", "stopped")
+        # janitor: feed segments orphaned by a crashed consumer. The age gate
+        # must exceed any plausible feed backlog (feed_timeout defaults to
+        # 600 s), so only segments a full day old are presumed dead.
+        from tensorflowonspark_tpu import shm
+
+        shm.unlink_leaked(max_age_secs=86400)
         return []
 
 

@@ -15,6 +15,7 @@ gathering them.
 """
 
 import dataclasses
+import os
 import re
 
 import jax
@@ -79,12 +80,20 @@ def _rope(x, positions, base=10000.0):
 
 _ATTENTION_IMPLS = ("auto", "flash", "plain", "ring")
 
+#: below this sequence length ``auto`` dispatch uses plain XLA attention on
+#: TPU instead of pad-to-128 + flash. Measured on-chip (docs/perf.md r3,
+#: B=4 H=8 D=64 bf16 causal, best-of-3 fenced): flash ≥ plain at every
+#: L ∈ {256, 512, 2048, 4096} and within relay noise at 1024, so the floor
+#: only guards the tiny-sequence regime where padding overhead dominates.
+_FLASH_MIN_SEQ = int(os.environ.get("TOS_FLASH_MIN_SEQ", "256"))
+
 
 def _dispatch_attention(q, k, v, impl, mesh):
     """Pick the attention path. ``auto``: ring over ``sp`` when the mesh
-    shards the sequence, else the pallas flash kernel on TPU, else plain XLA
-    attention. Forcing ``plain``/``flash``/``ring`` always wins (``plain`` on
-    an sp mesh is the debugging escape hatch — correct, just unsharded math).
+    shards the sequence, else the pallas flash kernel on TPU (plain below
+    ``TOS_FLASH_MIN_SEQ``), else plain XLA attention. Forcing
+    ``plain``/``flash``/``ring`` always wins (``plain`` on an sp mesh is the
+    debugging escape hatch — correct, just unsharded math).
     """
     if impl not in _ATTENTION_IMPLS:
         raise ValueError(
@@ -96,10 +105,12 @@ def _dispatch_attention(q, k, v, impl, mesh):
     if impl == "ring" or (impl == "auto" and has_sp):
         return ring_attention_sharded(q, k, v, mesh, causal=True)
     if impl == "flash" or jax.default_backend() == "tpu":
+        seq = q.shape[2]
+        if impl != "flash" and seq < _FLASH_MIN_SEQ:
+            return plain_attention(q, k, v, causal=True)
         from tensorflowonspark_tpu.ops.flash_attention import flash_attention
 
-        seq = q.shape[2]
-        pad = (-seq) % 128 if seq > 512 else 0
+        pad = (-seq) % 128
         if pad:
             # causal masking means queries < seq never attend to the zero
             # padding appended after them, so pad-run-slice is exact

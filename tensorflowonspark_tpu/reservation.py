@@ -123,6 +123,35 @@ class MessageSocket:
             return None
         return json.loads(payload.decode("utf-8"))
 
+    # raw frames (binary payload lanes, e.g. serving tensors) share the same
+    # 4-byte BE length framing so one implementation owns the wire format
+
+    def send_raw(self, payload):
+        self.sock.sendall(_HEADER.pack(len(payload)) + payload)
+
+    def recv_raw(self, max_bytes=None):
+        """One raw frame. Oversize frames are consumed-and-refused (the
+        stream stays in sync for the next message) — callers get a
+        ValueError they can answer with an error reply."""
+        header = self._recv_exact(_HEADER.size)
+        if header is None:
+            return None
+        (length,) = _HEADER.unpack(header)
+        if length < 0:
+            raise ConnectionError("corrupt raw frame length {}".format(length))
+        limit = _MAX_MSG if max_bytes is None else max_bytes
+        if length > limit:
+            remaining = length
+            while remaining:
+                chunk = self.sock.recv(min(1 << 20, remaining))
+                if not chunk:
+                    return None
+                remaining -= len(chunk)
+            raise ValueError(
+                "raw frame too large: {} bytes (limit {})".format(length, limit)
+            )
+        return self._recv_exact(length)
+
     def _recv_exact(self, n):
         buf = bytearray()
         while len(buf) < n:

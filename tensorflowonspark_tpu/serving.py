@@ -1,4 +1,5 @@
-"""Model-bundle inference server — the JVM-inference equivalent.
+"""Model-bundle inference server + batch-inference CLI — the JVM-inference
+equivalent.
 
 The reference shipped a Scala/JNI stack so JVM Spark jobs could run batch
 inference without Python (/root/reference/src/main/scala/com/yahoo/
@@ -6,8 +7,8 @@ tensorflowonspark/Inference.scala:17, TFModel.scala:38 — SavedModelBundle via
 libtensorflow). A jax model has no JNI runtime to embed, so the TPU-native
 equivalent is a host RPC: this server owns the model bundle (and the TPU
 chips) in a Python process, and any JVM executor talks to it over a tiny
-length-prefixed JSON protocol (``jvm/`` ships a dependency-free Java client
-for Spark mapPartitions; the wire format is specified in jvm/README.md).
+length-prefixed protocol (``jvm/`` ships a dependency-free Java client for
+Spark mapPartitions; the wire format is specified in jvm/README.md).
 
 Protocol (4-byte big-endian length + UTF-8 JSON, same framing as the
 reservation control plane):
@@ -16,15 +17,33 @@ reservation control plane):
 * ``{"type": "info"}`` → ``{"type": "info", "export_dir": ..., "ready": true}``
 * ``{"type": "predict", "inputs": {name: nested-lists, ...}}`` →
   ``{"type": "result", "outputs": {name: nested-lists, ...}}``
-* anything else / failure → ``{"type": "error", "message": ...}``
+* ``{"type": "predict_binary", "columns": [{"name","dtype","shape"},...]}``
+  followed by ONE raw frame (4-byte BE length + the columns' C-contiguous
+  little-endian buffers concatenated in order) →
+  ``{"type": "result_binary", "columns": [...]}`` + one raw frame — the
+  native-buffer lane matching the class of the reference's JVM tensor path
+  (TFModel.scala:121-244 moved tensors as nio buffers, not text).
+* anything else / failure → ``{"type": "error", "message": ...}`` (an error
+  reply is NEVER followed by a raw frame).
 
-Start standalone:  ``python -m tensorflowonspark_tpu.serving --export_dir
-/path/bundle --port 8500``
+Batch CLI (the reference's ``Inference.scala:52-79`` analogue — TFRecords
+in, predictions out as files, no server involved):
+
+    python -m tensorflowonspark_tpu.serving infer \
+        --tfrecords /data/shards --export_dir /models/bundle \
+        --output /data/preds [--format json|tfrecord] [--batch_size 128] \
+        [--input_mapping feature=tensor ...] [--output_mapping tensor=col ...]
+
+Start the server standalone:  ``python -m tensorflowonspark_tpu.serving
+serve --export_dir /path/bundle --port 8500`` (bare ``--export_dir ...``
+still serves, for round-2 compat).
 """
 
 import argparse
 import json
 import logging
+import os
+import queue
 import socket
 import threading
 
@@ -32,29 +51,173 @@ from tensorflowonspark_tpu.reservation import MessageSocket
 
 logger = logging.getLogger(__name__)
 
+#: binary tensor frames can be big (a 128-row ResNet batch is ~77 MB f32);
+#: framing itself lives on MessageSocket (send_raw/recv_raw) so one
+#: implementation owns the wire format
+MAX_BINARY_FRAME = int(os.environ.get("TOS_SERVING_MAX_FRAME", str(512 << 20)))
+
+
+def _columns_to_arrays(columns, payload):
+    """Decode the binary-lane column descriptors + concatenated payload."""
+    import numpy as np
+
+    arrays = {}
+    offset = 0
+    for col in columns:
+        dtype = np.dtype(col["dtype"])
+        shape = tuple(int(d) for d in col["shape"])
+        nbytes = int(dtype.itemsize * int(np.prod(shape, dtype=np.int64)))
+        if offset + nbytes > len(payload):
+            raise ValueError("binary payload shorter than declared columns")
+        arrays[col["name"]] = np.frombuffer(
+            payload, dtype=dtype, count=int(np.prod(shape, dtype=np.int64)), offset=offset
+        ).reshape(shape)
+        offset += nbytes
+    return arrays
+
+
+def _arrays_to_columns(arrays):
+    import numpy as np
+
+    columns, parts = [], []
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype.byteorder == ">":  # ship little-endian on the wire
+            arr = arr.astype(arr.dtype.newbyteorder("<"))
+        columns.append({"name": name, "dtype": arr.dtype.str, "shape": list(arr.shape)})
+        parts.append(arr.tobytes())
+    return columns, b"".join(parts)
+
+
+class _Predictor:
+    """Single predictor thread owning the chips: requests queue up, and
+    same-signature requests that are waiting together coalesce into ONE
+    model invocation (split back row-wise) — the replacement for round 2's
+    global lock, which serialized N clients into N dispatches.
+
+    A signature is (sorted column names, per-column dtype + trailing shape);
+    only axis-0 (batch) concatenation is ever performed, so results are
+    bit-identical to individual runs for row-wise models.
+    """
+
+    def __init__(self, predict_fn, params, model_state, max_rows=None):
+        self._predict_fn = predict_fn
+        self._params = params
+        self._model_state = model_state
+        self._max_rows = max_rows or int(os.environ.get("TOS_SERVING_COALESCE_ROWS", "1024"))
+        self._q = queue.Queue()
+        self._stop = object()
+        self._thread = threading.Thread(target=self._run, name="tos-predictor", daemon=True)
+        self._thread.start()
+
+    def submit(self, arrays):
+        """Blocking predict; thread-safe. Returns the outputs dict."""
+        from concurrent.futures import Future
+
+        fut = Future()
+        self._q.put((arrays, fut))
+        return fut.result()
+
+    def stop(self):
+        self._q.put(self._stop)
+        self._thread.join(timeout=10)
+
+    # -- internals ----------------------------------------------------------
+
+    @staticmethod
+    def _signature(arrays):
+        return tuple(
+            (name, arrays[name].dtype.str, arrays[name].shape[1:])
+            for name in sorted(arrays)
+        )
+
+    def _run(self):
+        import numpy as np
+
+        while True:
+            item = self._q.get()
+            if item is self._stop:
+                return
+            batch = [item]
+            sig = self._signature(item[0])
+            rows = next(iter(item[0].values())).shape[0] if item[0] else 0
+            # coalesce whatever same-signature requests are already waiting
+            backlog = []
+            while rows < self._max_rows:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is self._stop:
+                    backlog.append(nxt)
+                    break
+                if self._signature(nxt[0]) == sig and nxt[0]:
+                    batch.append(nxt)
+                    rows += next(iter(nxt[0].values())).shape[0]
+                else:
+                    backlog.append(nxt)
+            for b in backlog:  # preserve order for non-matching requests
+                self._q.put(b)
+
+            try:
+                if len(batch) == 1:
+                    arrays = batch[0][0]
+                else:
+                    arrays = {
+                        name: np.concatenate([req[0][name] for req in batch])
+                        for name in batch[0][0]
+                    }
+                outputs = self._predict_fn(self._params, self._model_state, arrays)
+                if not isinstance(outputs, dict):
+                    outputs = {"output": outputs}
+                outputs = {name: np.asarray(v) for name, v in outputs.items()}
+            except Exception as e:
+                for _arrays, fut in batch:
+                    fut.set_exception(e)
+                continue
+            if len(batch) == 1:
+                batch[0][1].set_result(outputs)
+            else:
+                start = 0
+                for req_arrays, fut in batch:
+                    n = next(iter(req_arrays.values())).shape[0]
+                    fut.set_result(
+                        {name: v[start : start + n] for name, v in outputs.items()}
+                    )
+                    start += n
+
 
 class InferenceServer:
-    """Serve one exported model bundle over TCP (thread per connection)."""
+    """Serve one exported model bundle over TCP.
 
-    def __init__(self, export_dir, host="", port=0):
+    Connections are handled by a bounded thread pool
+    (``TOS_SERVING_THREADS``, default 32) instead of round 2's unbounded
+    thread-per-connection; predictions funnel through the coalescing
+    :class:`_Predictor`."""
+
+    def __init__(self, export_dir, host="", port=0, max_threads=None):
         from tensorflowonspark_tpu.train import export
 
         self.export_dir = export_dir
         predict_fn, params, model_state = export.load_model(export_dir)
-        self._predict_fn = predict_fn
-        self._params = params
-        self._model_state = model_state
-        self._lock = threading.Lock()  # predictions serialized onto the chips
+        self._predictor = _Predictor(predict_fn, params, model_state)
+        self._max_threads = max_threads or int(os.environ.get("TOS_SERVING_THREADS", "32"))
+        self._pool = None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
-        self._sock.listen(16)
+        self._sock.listen(64)
         self.address = self._sock.getsockname()
         self._shutdown = threading.Event()
         self._thread = None
 
     def start(self):
-        self._thread = threading.Thread(target=self._serve, name="tos-serving", daemon=True)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=self._max_threads, thread_name_prefix="tos-serving"
+        )
+        self._thread = threading.Thread(target=self._serve, name="tos-serving-accept", daemon=True)
         self._thread.start()
         logger.info("inference server for %s at %s", self.export_dir, self.address)
         return self.address
@@ -68,6 +231,9 @@ class InferenceServer:
             pass
         if self._thread is not None:
             self._thread.join(timeout=10)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        self._predictor.stop()
         try:
             self._sock.close()
         except OSError:
@@ -84,9 +250,7 @@ class InferenceServer:
             if self._shutdown.is_set():
                 conn.close()
                 return
-            threading.Thread(
-                target=self._handle_conn, args=(conn,), daemon=True
-            ).start()
+            self._pool.submit(self._handle_conn, conn)
 
     def _handle_conn(self, conn):
         msock = MessageSocket(conn)
@@ -99,11 +263,36 @@ class InferenceServer:
                 if msg is None:
                     return
                 try:
-                    msock.send(self._handle(msg))
-                except OSError:
+                    if isinstance(msg, dict) and msg.get("type") == "predict_binary":
+                        self._handle_binary(msock, msg)
+                    else:
+                        msock.send(self._handle(msg))
+                except (OSError, ConnectionError):
                     return
         finally:
             msock.close()
+
+    def _handle_binary(self, msock, msg):
+        # recv_raw consumes oversize frames before raising, so an error
+        # reply always leaves the stream positioned at the next message
+        # (the documented lone-JSON-frame error contract)
+        try:
+            payload = msock.recv_raw(MAX_BINARY_FRAME)
+        except ValueError as e:
+            msock.send({"type": "error", "message": str(e)})
+            return
+        if payload is None:
+            raise ConnectionError("client closed mid-request")
+        try:
+            arrays = _columns_to_arrays(msg.get("columns") or [], payload)
+            outputs = self._predictor.submit(arrays)
+            columns, out_payload = _arrays_to_columns(outputs)
+        except Exception as e:
+            logger.exception("binary predict failed")
+            msock.send({"type": "error", "message": "{}: {}".format(type(e).__name__, e)})
+            return
+        msock.send({"type": "result_binary", "columns": columns})
+        msock.send_raw(out_payload)
 
     def _handle(self, msg):
         kind = msg.get("type") if isinstance(msg, dict) else None
@@ -123,10 +312,7 @@ class InferenceServer:
         import numpy as np
 
         arrays = {name: np.asarray(vals) for name, vals in inputs.items()}
-        with self._lock:
-            outputs = self._predict_fn(self._params, self._model_state, arrays)
-        if not isinstance(outputs, dict):
-            outputs = {"output": outputs}
+        outputs = self._predictor.submit(arrays)
         return {name: np.asarray(v).tolist() for name, v in outputs.items()}
 
 
@@ -161,17 +347,174 @@ class InferenceClient:
         }
         return self._request({"type": "predict", "inputs": inputs})["outputs"]
 
+    def predict_binary(self, **inputs):
+        """Binary tensor lane: numpy arrays in, numpy arrays out — no JSON
+        text encoding of the payloads (see module docstring)."""
+        import numpy as np
+
+        arrays = {k: np.asarray(v) for k, v in inputs.items()}
+        columns, payload = _arrays_to_columns(arrays)
+        self._msock.send({"type": "predict_binary", "columns": columns})
+        self._msock.send_raw(payload)
+        reply = self._msock.recv()
+        if reply is None:
+            raise ConnectionError("inference server closed the connection")
+        if reply.get("type") == "error":
+            raise RuntimeError(reply.get("message"))
+        out_payload = self._msock.recv_raw(MAX_BINARY_FRAME)
+        if out_payload is None:
+            raise ConnectionError("inference server closed mid-reply")
+        return _columns_to_arrays(reply["columns"], out_payload)
+
     def close(self):
         self._msock.close()
 
 
+# -- batch inference CLI (Inference.scala analogue) ----------------------------
+
+
+def _parse_mapping(pairs):
+    out = {}
+    for pair in pairs or []:
+        if "=" not in pair:
+            raise ValueError("mapping must be key=value, got {!r}".format(pair))
+        k, v = pair.split("=", 1)
+        out[k] = v
+    return out
+
+
+def run_batch_inference(
+    tfrecords_dir,
+    export_dir,
+    output_dir,
+    batch_size=128,
+    input_mapping=None,
+    output_mapping=None,
+    out_format="json",
+):
+    """TFRecord shards → bundle predictions → output shards (one output shard
+    per input shard; ``json`` = one JSON object per record per line,
+    ``tfrecord`` = serialized Examples). Reference ``Inference.scala:52-79``:
+    loadTFRecords → TFModel.transform → write.json.
+
+    ``input_mapping``: feature name → model input name (default: every
+    non-bytes feature feeds an input of the same name). ``output_mapping``:
+    model output name → output column name (default: keep names).
+    """
+    import numpy as np
+
+    from tensorflowonspark_tpu import tfrecord
+    from tensorflowonspark_tpu.train import export
+
+    predict_fn, params, model_state = export.load_model(export_dir)
+    predictor = _Predictor(predict_fn, params, model_state)
+    shards = tfrecord.list_shards(tfrecords_dir)
+    if not shards:
+        raise FileNotFoundError("no TFRecord shards under {}".format(tfrecords_dir))
+    os.makedirs(output_dir, exist_ok=True)
+    in_map = dict(input_mapping or {})
+    out_map = dict(output_mapping or {})
+    total = 0
+
+    def _rows_to_arrays(rows):
+        cols = {}
+        for name in rows[0]:
+            if in_map and name not in in_map:
+                continue
+            vals = [r[name] for r in rows]
+            if any(isinstance(v, (bytes, bytearray)) for v in vals[0]):
+                continue  # bytes features are not numeric model inputs
+            arr = np.asarray(vals)
+            if arr.shape[-1] == 1:  # scalar features decode as length-1 lists
+                arr = arr.reshape(arr.shape[:-1])
+            cols[in_map.get(name, name)] = arr
+        if not cols:
+            raise ValueError(
+                "no numeric input features in records (features: {})".format(sorted(rows[0]))
+            )
+        return cols
+
+    def _emit(outputs, n):
+        renamed = {out_map.get(name, name): np.asarray(v) for name, v in outputs.items()}
+        for i in range(n):
+            yield {name: np.asarray(v[i]).tolist() for name, v in renamed.items()}
+
+    try:
+        for shard in shards:
+            rows = [
+                {name: vals for name, (_kind, vals) in tfrecord.decode_example(rec).items()}
+                for rec in tfrecord.read_records(shard)
+            ]
+            base = os.path.basename(shard)
+            out_path = os.path.join(
+                output_dir, base + (".jsonl" if out_format == "json" else "")
+            )
+            records_out = []
+            for start in range(0, len(rows), batch_size):
+                chunk = rows[start : start + batch_size]
+                outputs = predictor.submit(_rows_to_arrays(chunk))
+                records_out.extend(_emit(outputs, len(chunk)))
+            if out_format == "json":
+                with open(out_path, "w") as f:
+                    for rec in records_out:
+                        f.write(json.dumps(rec) + "\n")
+            else:
+                with tfrecord.TFRecordWriter(out_path) as w:
+                    for rec in records_out:
+                        w.write(
+                            tfrecord.encode_example(
+                                {
+                                    k: v if isinstance(v, list) else [v]
+                                    for k, v in rec.items()
+                                }
+                            )
+                        )
+            total += len(records_out)
+            logger.info("wrote %d predictions to %s", len(records_out), out_path)
+    finally:
+        predictor.stop()
+    return total
+
+
 def main(argv=None):
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # round-2 compat: bare `--export_dir ...` means `serve`
+    if not argv or argv[0].startswith("-"):
+        argv = ["serve"] + argv
+
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--export_dir", required=True)
-    parser.add_argument("--host", default="")
-    parser.add_argument("--port", type=int, default=8500)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    serve_p = sub.add_parser("serve", help="serve a bundle over TCP")
+    serve_p.add_argument("--export_dir", required=True)
+    serve_p.add_argument("--host", default="")
+    serve_p.add_argument("--port", type=int, default=8500)
+
+    infer_p = sub.add_parser("infer", help="batch inference: TFRecords -> prediction shards")
+    infer_p.add_argument("--tfrecords", required=True, help="input TFRecord shard dir")
+    infer_p.add_argument("--export_dir", required=True)
+    infer_p.add_argument("--output", required=True, help="output dir for prediction shards")
+    infer_p.add_argument("--batch_size", type=int, default=128)
+    infer_p.add_argument("--format", choices=["json", "tfrecord"], default="json")
+    infer_p.add_argument("--input_mapping", nargs="*", default=None, metavar="FEATURE=TENSOR")
+    infer_p.add_argument("--output_mapping", nargs="*", default=None, metavar="TENSOR=COLUMN")
+
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+
+    if args.command == "infer":
+        total = run_batch_inference(
+            args.tfrecords, args.export_dir, args.output,
+            batch_size=args.batch_size,
+            input_mapping=_parse_mapping(args.input_mapping),
+            output_mapping=_parse_mapping(args.output_mapping),
+            out_format=args.format,
+        )
+        print(json.dumps({"inferred": total, "output": args.output}), flush=True)
+        return
+
     server = InferenceServer(args.export_dir, args.host, args.port)
     host, port = server.start()
     print(json.dumps({"serving": args.export_dir, "host": host or "0.0.0.0", "port": port}), flush=True)

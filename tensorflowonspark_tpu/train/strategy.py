@@ -245,7 +245,7 @@ class SyncDataParallel:
 
         return jax.jit(step, donate_argnums=(0,) if donate else ())
 
-    def compile_train_loop(self, loss_fn, optimizer, num_steps, has_aux=False, mutable=False, donate=True):
+    def compile_train_loop(self, loss_fn, optimizer, num_steps, has_aux=False, mutable=False, donate=True, packed=False):
         """Compile ``loop(state, batches) -> (state, last_metrics)`` running
         ``num_steps`` train steps INSIDE one XLA program via ``lax.scan``.
 
@@ -269,19 +269,38 @@ class SyncDataParallel:
         donated — treat the passed batches as consumed. ``donate="state"``
         donates only the state (for callers that re-feed the same device
         batches, e.g. synthetic-input benchmarks).
+
+        ``packed=True`` flips the input contract: ``loop(state, stacked)``
+        takes ONE device-resident pytree whose leaves carry a leading
+        ``num_steps`` axis (place with
+        :func:`tensorflowonspark_tpu.data.packed_prefetch`). For hosts behind
+        a high-latency device link, shipping the whole window as one transfer
+        amortizes the per-transfer fixed cost K× — measured on this
+        environment's relayed TPU the fixed cost is ~250 ms/transfer, which
+        dwarfs per-batch pipelining (docs/perf.md).
         """
         step = self.compile_train_step(
             loss_fn, optimizer, has_aux=has_aux, mutable=mutable, donate=False
         )
 
         def loop(state, batches):
-            if len(batches) != num_steps:
+            if packed:
+                lead = {leaf.shape[0] for leaf in jax.tree.leaves(batches)}
+                if lead != {num_steps}:
+                    raise ValueError(
+                        "packed window has leading dims {}, loop compiled for {}".format(
+                            sorted(lead), num_steps
+                        )
+                    )
+                stacked = batches
+            elif len(batches) != num_steps:
                 raise ValueError(
                     "got {} batches, loop compiled for {}".format(
                         len(batches), num_steps
                     )
                 )
-            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+            else:
+                stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
 
             def body(carry, batch):
                 new_state, metrics = step(carry, batch)

@@ -98,13 +98,28 @@ class TestDataFeed:
 
     def test_batch_results_roundtrip(self, ipc):
         from tensorflowonspark_tpu.marker import Chunk
+        from tensorflowonspark_tpu.shm import ShmChunk
+
+        import numpy as _np
 
         feed = TFNode.DataFeed(ipc)
-        feed.batch_results([42, 43])
-        # one chunked message per batch_results call; rows preserved 1:1
+        # numpy results ride the shared-memory lane (types round-trip as
+        # numpy either way)...
+        feed.batch_results(list(_np.asarray([42, 43])))
         out = ipc.get_queue("output")
         chunk = out.get()
+        assert isinstance(chunk, ShmChunk)
+        assert [int(v) for v in chunk.rows()] == [42, 43]
+
+        # ...while plain-Python rows pickle, so collectors see the exact
+        # types the worker produced (json.dumps-able ints, not np.int64)
+        feed.batch_results([42, 43])
+        chunk = out.get()
         assert isinstance(chunk, Chunk) and chunk.items == [42, 43]
+
+        feed.batch_results(["a", "b"])  # non-numeric -> pickled Chunk
+        chunk = out.get()
+        assert isinstance(chunk, Chunk) and chunk.items == ["a", "b"]
 
     def test_terminate_sets_state_and_drains(self, ipc):
         q = ipc.get_queue("input")

@@ -115,6 +115,29 @@ def test_resnet_real_data_end_to_end(tmp_path):
 
 
 @pytest.mark.slow
+def test_resnet_imagenet_real_data_end_to_end(tmp_path):
+    """The BASELINE north-star leg: ImageNet-schema JPEG TFRecords ->
+    resnet_spark --dataset imagenet through decode/distorted-crop/flip/
+    normalize (uint8 feed + on-device normalize) and the fused train loop
+    (VERDICT r2 item 2). image_size shrinks ResNet-50 to CI scale; the
+    code path is the 224 one."""
+    data = str(tmp_path / "imagenet_tfr")
+    model_dir = str(tmp_path / "model")
+    _run(
+        "resnet/resnet_data_setup.py", "--output", data, "--dataset", "imagenet",
+        "--num_examples", "96", "--num_shards", "2", "--image_size", "72",
+    )
+    out = _run(
+        "resnet/resnet_spark.py", "--dataset", "imagenet", "--data_dir", data,
+        "--train_steps", "4", "--batch_size", "8", "--log_steps", "2",
+        "--steps_per_loop", "2", "--image_size", "48", "--dtype", "fp32",
+        "--model_dir", model_dir, "--platform", "cpu", timeout=600,
+    )
+    assert "resnet training complete" in out
+    assert os.path.isdir(os.path.join(model_dir, "ckpt_4"))
+
+
+@pytest.mark.slow
 def test_mnist_pipeline_then_parallel_inference(tmp_path):
     """The remaining two BASELINE mnist configs at example level: the
     Spark-ML pipeline (TFEstimator fit -> bundle -> TFModel transform) and
