@@ -139,13 +139,15 @@ public final class InferenceClient implements Closeable {
     String type = topLevelType(text);
     if ("error".equals(type)) throw new IOException("server error: " + text);
     if (!"result_binary".equals(type)) throw new IOException("unexpected reply: " + text);
-    // first column's dtype + shape (fixed message shape; minimal parsing)
-    String dtype = extractString(text, "\"dtype\"");
-    int[] shape = extract2dShape(text);
+    // drain the raw frame BEFORE validating the header: a validation throw
+    // must leave the persistent connection positioned at the next message
     int blen = in.readInt();
     if (blen < 0) throw new IOException("bad binary frame length " + blen);
     byte[] raw = new byte[blen];
     in.readFully(raw);
+    // first column's dtype + shape (fixed message shape; minimal parsing)
+    String dtype = extractString(text, "\"dtype\"");
+    int[] shape = extract2dShape(text);
     java.nio.ByteBuffer buf =
         java.nio.ByteBuffer.wrap(raw).order(java.nio.ByteOrder.LITTLE_ENDIAN);
     float[][] result = new float[shape[0]][shape[1]];

@@ -1,0 +1,127 @@
+"""Bring up (or plan) a Spark-standalone cluster on a Cloud TPU pod slice.
+
+The reference shipped a forked amplab ``spark-ec2`` launcher
+(/root/reference/scripts/spark_ec2.py, 1544 LoC) that created EC2 instances
+and bootstrapped a standalone Spark cluster on them. The TPU-era equivalent
+targets a TPU pod slice: one Spark worker per TPU host (the framework's hard
+invariant — each executor owns its host's chips), master on host 0.
+
+By default this tool is a PLANNER: it prints the exact command sequence
+(gcloud TPU VM creation, per-host Spark bootstrap over SSH, spark-env
+settings, teardown) so operators can audit/adapt it. ``--apply`` executes
+the plan with subprocess when ``gcloud`` is installed — the build/CI image
+has no cloud CLI or egress, so execution is exercised only in the field;
+the plan content is pinned by ``tests/test_launch_tool.py``.
+
+Usage:
+    python scripts/launch_tpu_spark.py plan  --name tos --zone us-central2-b \
+        --accelerator v5e-32 --spark_version 3.5.1
+    python scripts/launch_tpu_spark.py plan  --teardown --name tos --zone ...
+    python scripts/launch_tpu_spark.py apply ...   # same flags; executes
+"""
+
+import argparse
+import shlex
+import subprocess
+import sys
+
+#: TPU hosts per slice for the supported accelerator types (chips/slice ÷ 4
+#: chips/host for v4/v5p, ÷ 8 for v5e/v6e host machines where applicable —
+#: values are the VM worker counts gcloud reports for each topology)
+HOSTS = {
+    "v4-8": 1, "v4-16": 2, "v4-32": 4, "v4-64": 8,
+    "v5e-4": 1, "v5e-8": 1, "v5e-16": 2, "v5e-32": 4, "v5e-64": 8, "v5e-128": 16,
+    "v5p-8": 1, "v5p-16": 2, "v5p-32": 4,
+    "v6e-4": 1, "v6e-8": 1, "v6e-16": 2, "v6e-32": 4,
+}
+
+
+def plan_commands(args):
+    """The ordered shell commands for bring-up (or teardown)."""
+    tpu = "gcloud compute tpus tpu-vm"
+    target = "{} --zone {}".format(args.name, args.zone)
+    if args.teardown:
+        return [
+            "{} delete {} --quiet".format(tpu, target),
+        ]
+    n_hosts = HOSTS.get(args.accelerator)
+    if n_hosts is None:
+        raise SystemExit(
+            "unknown accelerator {!r}; known: {}".format(
+                args.accelerator, " ".join(sorted(HOSTS))
+            )
+        )
+    spark_tgz = "spark-{v}-bin-hadoop3".format(v=args.spark_version)
+    spark_url = "https://archive.apache.org/dist/spark/spark-{v}/{t}.tgz".format(
+        v=args.spark_version, t=spark_tgz
+    )
+    all_hosts = "--worker=all"
+    cmds = [
+        # 1. the slice: one VM per TPU host, chips attached
+        "{} create {} --accelerator-type {} --version {}".format(
+            tpu, target, args.accelerator, args.runtime_version
+        ),
+        # 2. software on every host: Spark + the framework wheel
+        "{} ssh {} {} --command {}".format(
+            tpu, target, all_hosts,
+            shlex.quote(
+                "curl -fsSL {url} | tar xz -C $HOME && "
+                "pip install tensorflowonspark-tpu".format(url=spark_url)
+            ),
+        ),
+        # 3. master on host 0
+        "{} ssh {} --worker=0 --command {}".format(
+            tpu, target,
+            shlex.quote("$HOME/{t}/sbin/start-master.sh".format(t=spark_tgz)),
+        ),
+        # 4. ONE worker per TPU host, one task slot each (the framework's
+        #    task-per-executor invariant; reference test/run_tests.sh:16-19
+        #    used the same shape: SPARK_WORKER_INSTANCES with 1 core each)
+        "{} ssh {} {} --command {}".format(
+            tpu, target, all_hosts,
+            shlex.quote(
+                "MASTER_ADDR=$(getent hosts t1v-n-0 | awk '{{print $1}}'); "
+                "SPARK_WORKER_CORES=1 $HOME/{t}/sbin/start-worker.sh "
+                "spark://$MASTER_ADDR:7077".format(t=spark_tgz)
+            ),
+        ),
+        # 5. smoke-check: submit the bundled MNIST example from host 0
+        "{} ssh {} --worker=0 --command {}".format(
+            tpu, target,
+            shlex.quote(
+                "MASTER=spark://$(hostname):7077 python -m "
+                "tensorflowonspark_tpu.examples.mnist_spark "
+                "--cluster_size {n} --epochs 1".format(n=n_hosts)
+            ),
+        ),
+    ]
+    return cmds
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("mode", choices=["plan", "apply"])
+    parser.add_argument("--name", default="tos-tpu")
+    parser.add_argument("--zone", required=True)
+    parser.add_argument("--accelerator", default="v5e-32")
+    parser.add_argument("--runtime_version", default="tpu-ubuntu2204-base")
+    parser.add_argument("--spark_version", default="3.5.1")
+    parser.add_argument("--teardown", action="store_true")
+    args = parser.parse_args(argv)
+
+    cmds = plan_commands(args)
+    try:
+        for cmd in cmds:
+            print(cmd)
+            if args.mode == "apply":
+                rc = subprocess.call(cmd, shell=True)
+                if rc != 0:
+                    print("command failed (rc={}); stopping".format(rc), file=sys.stderr)
+                    return rc
+    except BrokenPipeError:  # plan piped into head etc.
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -191,10 +191,15 @@ class DataFeed:
                     break
             elif isinstance(item, Chunk) or _is_shm_chunk(item):
                 # pickled chunk or shared-memory descriptor (the latter's
-                # payload never crossed the Manager socket: rows() is a
-                # materialize-memcpy + unlink); either way task_done is
-                # deferred until the last row is consumed
-                rows = item.items if isinstance(item, Chunk) else item.rows()
+                # payload never crossed the Manager socket); either way
+                # task_done is deferred until the last row is consumed.
+                # Numpy consumers get zero-ish-copy numpy rows; plain
+                # consumers get Python-typed rows (tolist) so the shm lane
+                # never changes the types user code observes.
+                if isinstance(item, Chunk):
+                    rows = item.items
+                else:
+                    rows = item.rows() if as_numpy else item.py_rows()
                 self._pending.extend(rows)
                 self._chunk_open = bool(self._pending)
                 if not self._pending:  # defensive: empty chunk

@@ -101,12 +101,19 @@ class _Predictor:
     """
 
     def __init__(self, predict_fn, params, model_state, max_rows=None):
+        import collections
+
         self._predict_fn = predict_fn
         self._params = params
         self._model_state = model_state
         self._max_rows = max_rows or int(os.environ.get("TOS_SERVING_COALESCE_ROWS", "1024"))
         self._q = queue.Queue()
         self._stop = object()
+        #: deferred non-matching requests, served FIRST next cycle — keeps
+        #: FIFO so a minority-signature request can't be starved by sustained
+        #: majority-signature load
+        self._backlog = collections.deque()
+        self._stopped = False
         self._thread = threading.Thread(target=self._run, name="tos-predictor", daemon=True)
         self._thread.start()
 
@@ -114,13 +121,28 @@ class _Predictor:
         """Blocking predict; thread-safe. Returns the outputs dict."""
         from concurrent.futures import Future
 
+        if self._stopped:
+            raise RuntimeError("predictor stopped")
         fut = Future()
         self._q.put((arrays, fut))
         return fut.result()
 
     def stop(self):
+        self._stopped = True
         self._q.put(self._stop)
         self._thread.join(timeout=10)
+        # fail any request that was still queued so no caller blocks forever
+        # on a future that will never resolve
+        leftovers = list(self._backlog)
+        self._backlog.clear()
+        while True:
+            try:
+                leftovers.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        for item in leftovers:
+            if item is not self._stop:
+                item[1].set_exception(RuntimeError("predictor stopped"))
 
     # -- internals ----------------------------------------------------------
 
@@ -135,29 +157,35 @@ class _Predictor:
         import numpy as np
 
         while True:
-            item = self._q.get()
+            item = self._backlog.popleft() if self._backlog else self._q.get()
             if item is self._stop:
+                # drain anything that raced in behind the sentinel
+                for pending in self._backlog:
+                    pending[1].set_exception(RuntimeError("predictor stopped"))
+                self._backlog.clear()
                 return
             batch = [item]
             sig = self._signature(item[0])
             rows = next(iter(item[0].values())).shape[0] if item[0] else 0
-            # coalesce whatever same-signature requests are already waiting
-            backlog = []
-            while rows < self._max_rows:
+            # coalesce same-signature requests already waiting; non-matching
+            # ones go to the backlog, which is served FIRST next cycle (FIFO
+            # within one deferral — a minority-signature request waits at
+            # most one predict cycle)
+            scanned = []
+            while rows < self._max_rows and not self._backlog:
                 try:
                     nxt = self._q.get_nowait()
                 except queue.Empty:
                     break
                 if nxt is self._stop:
-                    backlog.append(nxt)
+                    scanned.append(nxt)
                     break
                 if self._signature(nxt[0]) == sig and nxt[0]:
                     batch.append(nxt)
                     rows += next(iter(nxt[0].values())).shape[0]
                 else:
-                    backlog.append(nxt)
-            for b in backlog:  # preserve order for non-matching requests
-                self._q.put(b)
+                    scanned.append(nxt)
+            self._backlog.extend(scanned)
 
             try:
                 if len(batch) == 1:
@@ -210,6 +238,11 @@ class InferenceServer:
         self.address = self._sock.getsockname()
         self._shutdown = threading.Event()
         self._thread = None
+        #: live client connections — closed on stop() so pool threads blocked
+        #: in recv() unblock (pool threads are non-daemon; without this an
+        #: idle persistent client would hang interpreter shutdown)
+        self._conns = set()
+        self._conns_lock = threading.Lock()
 
     def start(self):
         from concurrent.futures import ThreadPoolExecutor
@@ -231,9 +264,20 @@ class InferenceServer:
             pass
         if self._thread is not None:
             self._thread.join(timeout=10)
-        if self._pool is not None:
-            self._pool.shutdown(wait=False)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
         self._predictor.stop()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
         try:
             self._sock.close()
         except OSError:
@@ -253,6 +297,8 @@ class InferenceServer:
             self._pool.submit(self._handle_conn, conn)
 
     def _handle_conn(self, conn):
+        with self._conns_lock:
+            self._conns.add(conn)
         msock = MessageSocket(conn)
         try:
             while True:
@@ -271,6 +317,8 @@ class InferenceServer:
                     return
         finally:
             msock.close()
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     def _handle_binary(self, msock, msg):
         # recv_raw consumes oversize frames before raising, so an error
@@ -391,6 +439,7 @@ def run_batch_inference(
     input_mapping=None,
     output_mapping=None,
     out_format="json",
+    server=None,
 ):
     """TFRecord shards → bundle predictions → output shards (one output shard
     per input shard; ``json`` = one JSON object per record per line,
@@ -400,14 +449,30 @@ def run_batch_inference(
     ``input_mapping``: feature name → model input name (default: every
     non-bytes feature feeds an input of the same name). ``output_mapping``:
     model output name → output column name (default: keep names).
+    ``server``: ``(host, port)`` of a running :class:`InferenceServer` —
+    batches go over the binary tensor lane instead of loading the bundle
+    in-process (what a JVM executor does; ``export_dir`` may be None then).
     """
     import numpy as np
 
     from tensorflowonspark_tpu import tfrecord
-    from tensorflowonspark_tpu.train import export
 
-    predict_fn, params, model_state = export.load_model(export_dir)
-    predictor = _Predictor(predict_fn, params, model_state)
+    if server is not None:
+        client = InferenceClient(server)
+        predictor = None
+
+        def _submit(arrays):
+            return client.predict_binary(**arrays)
+
+        def _stop():
+            client.close()
+    else:
+        from tensorflowonspark_tpu.train import export
+
+        predict_fn, params, model_state = export.load_model(export_dir)
+        predictor = _Predictor(predict_fn, params, model_state)
+        _submit = predictor.submit
+        _stop = predictor.stop
     shards = tfrecord.list_shards(tfrecords_dir)
     if not shards:
         raise FileNotFoundError("no TFRecord shards under {}".format(tfrecords_dir))
@@ -452,7 +517,7 @@ def run_batch_inference(
             records_out = []
             for start in range(0, len(rows), batch_size):
                 chunk = rows[start : start + batch_size]
-                outputs = predictor.submit(_rows_to_arrays(chunk))
+                outputs = _submit(_rows_to_arrays(chunk))
                 records_out.extend(_emit(outputs, len(chunk)))
             if out_format == "json":
                 with open(out_path, "w") as f:
@@ -472,7 +537,7 @@ def run_batch_inference(
             total += len(records_out)
             logger.info("wrote %d predictions to %s", len(records_out), out_path)
     finally:
-        predictor.stop()
+        _stop()
     return total
 
 
@@ -480,8 +545,11 @@ def main(argv=None):
     import sys
 
     argv = list(sys.argv[1:] if argv is None else argv)
-    # round-2 compat: bare `--export_dir ...` means `serve`
-    if not argv or argv[0].startswith("-"):
+    # round-2 compat: bare `--export_dir ...` means `serve` — but top-level
+    # --help must still show BOTH subcommands
+    if not argv:
+        argv = ["serve"]
+    elif argv[0].startswith("-") and argv[0] not in ("-h", "--help"):
         argv = ["serve"] + argv
 
     parser = argparse.ArgumentParser(description=__doc__)
@@ -494,23 +562,34 @@ def main(argv=None):
 
     infer_p = sub.add_parser("infer", help="batch inference: TFRecords -> prediction shards")
     infer_p.add_argument("--tfrecords", required=True, help="input TFRecord shard dir")
-    infer_p.add_argument("--export_dir", required=True)
+    infer_p.add_argument("--export_dir", default=None,
+                         help="bundle dir (in-process inference; omit with --server)")
     infer_p.add_argument("--output", required=True, help="output dir for prediction shards")
     infer_p.add_argument("--batch_size", type=int, default=128)
     infer_p.add_argument("--format", choices=["json", "tfrecord"], default="json")
     infer_p.add_argument("--input_mapping", nargs="*", default=None, metavar="FEATURE=TENSOR")
     infer_p.add_argument("--output_mapping", nargs="*", default=None, metavar="TENSOR=COLUMN")
+    infer_p.add_argument("--server", default=None, metavar="HOST:PORT",
+                         help="route batches to a running InferenceServer over "
+                              "the binary tensor lane instead of loading the bundle")
 
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
     if args.command == "infer":
+        if args.server is None and args.export_dir is None:
+            infer_p.error("one of --export_dir / --server is required")
+        server_addr = None
+        if args.server is not None:
+            host, _, port = args.server.rpartition(":")
+            server_addr = (host or "127.0.0.1", int(port))
         total = run_batch_inference(
             args.tfrecords, args.export_dir, args.output,
             batch_size=args.batch_size,
             input_mapping=_parse_mapping(args.input_mapping),
             output_mapping=_parse_mapping(args.output_mapping),
             out_format=args.format,
+            server=server_addr,
         )
         print(json.dumps({"inferred": total, "output": args.output}), flush=True)
         return

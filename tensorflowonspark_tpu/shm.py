@@ -172,6 +172,17 @@ class ShmChunk(Marker):
             return list(cols[0])
         return list(zip(*cols))
 
+    def py_rows(self):
+        """Materialize as PYTHON-typed rows (lists/ints/floats via
+        ``tolist``): the type-faithful path for consumers that expect the
+        exact objects the feeder saw (user ``main_fun`` code iterating rows
+        without ``as_numpy``). Numeric fidelity is exact — the lane only
+        carries uniform numeric rows in the first place."""
+        cols = [c.tolist() for c in self.materialize()]
+        if self.single:
+            return cols[0]
+        return list(zip(*cols))
+
     def discard(self):
         """Unlink without reading (drain paths)."""
         from multiprocessing import shared_memory

@@ -1,0 +1,46 @@
+"""Cluster bring-up planner (the spark_ec2.py analogue, VERDICT r2 missing
+item 5): the generated command plan is pinned here; execution (``apply``)
+requires gcloud and runs only in the field."""
+
+import argparse
+
+from scripts.launch_tpu_spark import HOSTS, plan_commands
+
+
+def _args(**kw):
+    defaults = dict(
+        name="tos", zone="us-central2-b", accelerator="v5e-32",
+        runtime_version="tpu-ubuntu2204-base", spark_version="3.5.1",
+        teardown=False,
+    )
+    defaults.update(kw)
+    return argparse.Namespace(**defaults)
+
+
+def test_plan_shape_and_order():
+    cmds = plan_commands(_args())
+    assert len(cmds) == 5
+    assert "tpu-vm create tos --zone us-central2-b" in cmds[0]
+    assert "--accelerator-type v5e-32" in cmds[0]
+    assert "spark-3.5.1-bin-hadoop3" in cmds[1] and "--worker=all" in cmds[1]
+    assert "start-master.sh" in cmds[2] and "--worker=0" in cmds[2]
+    # one worker per host, ONE core each: the task-per-executor invariant
+    assert "SPARK_WORKER_CORES=1" in cmds[3] and "--worker=all" in cmds[3]
+    assert "--cluster_size 4" in cmds[4]  # v5e-32 = 4 TPU hosts
+
+
+def test_teardown_plan():
+    cmds = plan_commands(_args(teardown=True))
+    assert len(cmds) == 1 and "delete tos" in cmds[0]
+
+
+def test_unknown_accelerator_fails_loudly():
+    import pytest
+
+    with pytest.raises(SystemExit, match="unknown accelerator"):
+        plan_commands(_args(accelerator="v99-1"))
+
+
+def test_host_table_consistency():
+    assert HOSTS["v5e-32"] == 4
+    assert all(isinstance(v, int) and v >= 1 for v in HOSTS.values())

@@ -192,6 +192,30 @@ def test_concurrent_clients_all_served(server):
     assert len(results) == 12
 
 
+def test_stop_with_idle_persistent_connection(tmp_path):
+    """stop() must complete even while a client holds an idle persistent
+    connection (pool threads are non-daemon; the server closes live
+    connections to unblock them)."""
+    import threading
+    import time
+
+    srv = InferenceServer(_bundle(tmp_path))
+    srv.start()
+    client = InferenceClient(srv.address)
+    assert client.ping()
+    t0 = time.time()
+    done = threading.Event()
+
+    def _stop():
+        srv.stop()
+        done.set()
+
+    threading.Thread(target=_stop, daemon=True).start()
+    assert done.wait(timeout=30), "server.stop() hung on an idle connection"
+    assert time.time() - t0 < 30
+    client.close()
+
+
 def test_coalescing_matches_individual_runs(tmp_path):
     """Coalesced concurrent requests return exactly what individual runs
     return (axis-0 concat + split is the only transformation)."""
@@ -254,6 +278,31 @@ def test_batch_inference_cli(tmp_path):
     # y = 2*x0 + 3*x1 + 1 = 2i + 6i + 1
     np.testing.assert_allclose(
         [p["prediction"][0] for p in preds], [8.0 * i + 1.0 for i in range(10)]
+    )
+
+
+def test_batch_inference_through_live_server(tmp_path, server):
+    """TFRecord shard → RUNNING server (binary tensor lane) → output shard —
+    the full JVM-story round trip (VERDICT r2 item 4 done-criterion)."""
+    from tensorflowonspark_tpu import tfrecord
+    from tensorflowonspark_tpu.serving import run_batch_inference
+
+    data_dir = str(tmp_path / "records")
+    import os
+
+    os.makedirs(data_dir)
+    with tfrecord.TFRecordWriter(os.path.join(data_dir, "part-00000")) as w:
+        for i in range(7):
+            w.write(tfrecord.encode_example({"x": [float(i), 1.0]}))
+    out_dir = str(tmp_path / "preds")
+    total = run_batch_inference(
+        data_dir, None, out_dir, batch_size=3, server=server.address,
+    )
+    assert total == 7
+    with open(os.path.join(out_dir, "part-00000.jsonl")) as f:
+        preds = [json.loads(line) for line in f]
+    np.testing.assert_allclose(
+        [p["y_"][0] for p in preds], [2.0 * i + 4.0 for i in range(7)]
     )
 
 

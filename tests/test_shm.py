@@ -125,6 +125,31 @@ def test_datafeed_consumes_shm_chunks():
         mgr.shutdown()
 
 
+def test_datafeed_plain_consumer_gets_python_types():
+    """Without as_numpy, the shm lane delivers the exact Python types the
+    feeder saw — no silent list→ndarray / int→np.int64 changes inside user
+    main_fun code."""
+    from tensorflowonspark_tpu import TFManager
+    from tensorflowonspark_tpu.TFNode import DataFeed
+
+    mgr = TFManager.start(b"shm-test-py", ["input", "output"], mode="local")
+    try:
+        q = mgr.get_queue("input")
+        rows = [([1.0, 2.0, 3.0], 7), ([4.0, 5.0, 6.0], 8)]
+        q.put(ShmChunk.from_rows(rows))
+        q.put(None)
+        feed = DataFeed(mgr, train_mode=False)
+        batch = feed.next_batch(4)
+        assert len(batch) == 2
+        assert isinstance(batch[0][0], list) and batch[0][0] == [1.0, 2.0, 3.0]
+        assert type(batch[0][1]) is int and batch[0][1] == 7
+        import json as _json
+
+        _json.dumps(batch)  # fully JSON-serializable, as pickled rows were
+    finally:
+        mgr.shutdown()
+
+
 def test_datafeed_terminate_discards_unread_segments():
     from tensorflowonspark_tpu import TFManager
     from tensorflowonspark_tpu.TFNode import DataFeed
