@@ -63,14 +63,46 @@ def bench_resnet(tiny, real_data):
     n_chips = jax.device_count()
     batch = int(os.environ.get("BENCH_BATCH", 8 if tiny else 128)) * n_chips
     steps = int(os.environ.get("BENCH_STEPS", 3 if tiny else 20))
-    # K train steps fused into one lax.scan dispatch (0/1 = per-step dispatch)
-    fused = int(os.environ.get("BENCH_FUSED", 0 if tiny else 8))
-    # packed: ship each K-step window as ONE transfer (amortizes the
-    # per-transfer fixed cost of relayed TPU links; BENCH_PACKED=0 reverts
-    # to per-batch transfers overlapped via loop_prefetch)
-    packed = real_data and fused > 1 and os.environ.get("BENCH_PACKED", "1") == "1"
     image_size = 32 if tiny else 224
     dtype = jnp.float32 if tiny else jnp.bfloat16
+    # K train steps fused into one lax.scan dispatch (0/1 = per-step dispatch)
+    fused = int(os.environ.get("BENCH_FUSED", 0 if tiny else 8))
+    packed = False
+    link_fixed_s = link_bw_mbps = None
+    if real_data and not tiny:
+        # probe the link BEFORE choosing the transfer shape: two sizes solve
+        # T = fixed + size/bw. When the fixed cost rivals a batch's stream
+        # time, shipping the whole K-step window as ONE transfer (packed)
+        # amortizes it K x; when bandwidth dominates, per-batch overlapped
+        # transfers win. This relay swings between both regimes (perf.md),
+        # so the bench adapts per run. BENCH_PACKED=0/1 forces.
+        import jax as _jax
+        import numpy as _np
+
+        def _probe(nbytes, reps=3):
+            # min-of-N: transient relay stalls otherwise corrupt the model
+            arr = _np.zeros((nbytes,), _np.uint8)
+            _jax.block_until_ready(_jax.device_put(arr))
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                a = _jax.device_put(arr)
+                _np.asarray(a[0])
+                times.append(time.perf_counter() - t0)
+            return min(times)
+
+        t_small, t_big = _probe(1 << 20), _probe(16 << 20)
+        # bw capped at 1 GB/s: below the cap the 15 MB size delta is
+        # measurable; above it the link is not the bottleneck anyway and the
+        # ceiling falls back to the reference constant
+        link_bw_mbps = 15.0 / max(t_big - t_small, 0.015)
+        link_fixed_s = max(t_small - 1.0 / link_bw_mbps, 0.0)
+        mode_env = os.environ.get("BENCH_PACKED", "auto")
+        if mode_env == "auto":
+            batch_mb = batch * image_size * image_size * 3 / 1e6
+            packed = fused > 1 and link_fixed_s > batch_mb / link_bw_mbps
+        else:
+            packed = fused > 1 and mode_env == "1"
 
     mesh = parallel.build_mesh({"dp": n_chips})
     strategy = SyncDataParallel(mesh)
@@ -175,26 +207,30 @@ def bench_resnet(tiny, real_data):
     suffix = "_realdata" if real_data else ""
     baseline = REFERENCE_IMG_PER_SEC_PER_CHIP
     unit = "images/sec/chip"
-    if real_data and not tiny:
+    if real_data and not tiny and link_bw_mbps is not None:
         # Real data must cross the host->device link; when that link is
         # slower than the chip (relayed/tunneled TPU runtimes), the
-        # feasible ceiling is the link's stream bandwidth, not the chip.
-        # Measure it and normalize against min(reference, link ceiling) so
-        # vs_baseline reads "fraction of this environment's achievable
+        # feasible ceiling is the link's capability for the CHOSEN transfer
+        # shape — per-batch transfers, or one whole window when packed.
+        # Normalizing against min(reference, link ceiling) makes
+        # vs_baseline read "fraction of this environment's achievable
         # real-data throughput" (on co-located TPU hosts the probe is fast
         # and the denominator falls back to the reference constant).
-        probe = np.zeros((16 << 20,), np.uint8)
-        jax.block_until_ready(jax.device_put(probe))
-        t0 = time.perf_counter()
-        for _ in range(2):
-            a = jax.device_put(probe)
-            np.asarray(a[0])
-        link_mbps = 2 * probe.nbytes / (time.perf_counter() - t0) / 1e6
-        img_mb = image_size * image_size * 3 / 1e6  # uint8 feed bytes/image
-        link_ceiling = link_mbps / img_mb / n_chips
+        batch_mb = batch * image_size * image_size * 3 / 1e6  # uint8 feed
+        per_xfer_imgs = fused * batch if packed else batch
+        per_xfer_mb = fused * batch_mb if packed else batch_mb
+        link_ceiling = (
+            per_xfer_imgs / (link_fixed_s + per_xfer_mb / link_bw_mbps) / n_chips
+        )
         if link_ceiling < baseline:
             baseline = link_ceiling
-            unit = "images/sec/chip (link-limited: {:.0f} MB/s)".format(link_mbps)
+            unit = (
+                "images/sec/chip (link-limited: {:.0f} MB/s + {:.0f} ms/transfer"
+                "{})".format(
+                    link_bw_mbps, link_fixed_s * 1000,
+                    ", packed windows" if packed else "",
+                )
+            )
     return {
         "metric": "{}{}_train_images_per_sec_per_chip".format(name, suffix),
         "value": round(value, 2),

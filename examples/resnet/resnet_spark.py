@@ -158,6 +158,44 @@ def main_fun(args, ctx):
                 jax.device_get(state),
             )
 
+    if args.eval_dir and ctx.executor_id == 0:
+        # the reference's per-run top-1 eval (resnet_imagenet_main.py):
+        # aspect-preserving resize + central crop, no augmentation. Runs on
+        # the FIRST worker only, over ALL eval shards, with host-gathered
+        # params and no mesh: eval must not enter collectives (uneven
+        # per-worker shard counts would hang the world) and must score every
+        # example (drop_remainder=False keeps the short final batch).
+        from tensorflowonspark_tpu import tfrecord as tfr
+        from tensorflowonspark_tpu.data import ImagePipeline
+        from tensorflowonspark_tpu.data import cifar as cifar_data
+        from tensorflowonspark_tpu.data import imagenet as imagenet_data
+
+        eval_files = tfr.list_shards(args.eval_dir)
+        parse = (
+            cifar_data.make_parse_fn(False)
+            if args.dataset == "cifar"
+            else imagenet_data.make_parse_fn(
+                False, image_size=image_size, label_offset=args.label_offset,
+                raw_uint8=feed_uint8,
+            )
+        )
+        eval_fn = jax.jit(resnet.make_eval_fn(
+            model, normalize=imagenet_mod.device_normalize if feed_uint8 else None
+        ))
+        params_host = jax.device_get(state.params)
+        model_state_host = jax.device_get(state.model_state)
+        correct = total = 0
+        pipe = ImagePipeline(
+            eval_files, parse, args.batch_size, shuffle=False, epochs=1,
+            drop_remainder=False,
+        )
+        for b in pipe:
+            c, n = eval_fn(params_host, model_state_host, b)
+            correct += int(jax.device_get(c))
+            total += int(n)
+        if total:
+            print("eval accuracy {:.4f} ({} examples)".format(correct / total, total))
+
 
 def main(argv=None):
     parser = argparse.ArgumentParser()
@@ -166,6 +204,8 @@ def main(argv=None):
     parser.add_argument("--data_dir", default=None, help="TFRecord shard dir (real-data mode)")
     parser.add_argument("--data_threads", type=int, default=8)
     parser.add_argument("--dataset", choices=["cifar", "imagenet"], default="cifar")
+    parser.add_argument("--eval_dir", default=None,
+                        help="TFRecord shard dir for post-training top-1 eval")
     parser.add_argument("--dtype", choices=["bf16", "fp32"], default="bf16")
     parser.add_argument("--image_size", type=int, default=None,
                         help="override the dataset's native size (tests/CI)")

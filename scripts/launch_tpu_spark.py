@@ -21,19 +21,14 @@ Usage:
 """
 
 import argparse
+import os
 import shlex
 import subprocess
 import sys
 
-#: TPU hosts per slice for the supported accelerator types (chips/slice ÷ 4
-#: chips/host for v4/v5p, ÷ 8 for v5e/v6e host machines where applicable —
-#: values are the VM worker counts gcloud reports for each topology)
-HOSTS = {
-    "v4-8": 1, "v4-16": 2, "v4-32": 4, "v4-64": 8,
-    "v5e-4": 1, "v5e-8": 1, "v5e-16": 2, "v5e-32": 4, "v5e-64": 8, "v5e-128": 16,
-    "v5p-8": 1, "v5p-16": 2, "v5p-32": 4,
-    "v6e-4": 1, "v6e-8": 1, "v6e-16": 2, "v6e-32": 4,
-}
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tensorflowonspark_tpu import tpu_info  # noqa: E402  (host-count rules)
 
 
 def plan_commands(args):
@@ -44,11 +39,11 @@ def plan_commands(args):
         return [
             "{} delete {} --quiet".format(tpu, target),
         ]
-    n_hosts = HOSTS.get(args.accelerator)
+    n_hosts = tpu_info.num_hosts_for(args.accelerator)
     if n_hosts is None:
         raise SystemExit(
-            "unknown accelerator {!r}; known: {}".format(
-                args.accelerator, " ".join(sorted(HOSTS))
+            "unknown accelerator {!r}; known generations: {}".format(
+                args.accelerator, " ".join(sorted(tpu_info._GENERATIONS))
             )
         )
     spark_tgz = "spark-{v}-bin-hadoop3".format(v=args.spark_version)
@@ -61,7 +56,8 @@ def plan_commands(args):
         "{} create {} --accelerator-type {} --version {}".format(
             tpu, target, args.accelerator, args.runtime_version
         ),
-        # 2. software on every host: Spark + the framework wheel
+        # 2. software on every host: Spark + the framework wheel; examples
+        #    are repo files, not part of the wheel — push the one we smoke with
         "{} ssh {} {} --command {}".format(
             tpu, target, all_hosts,
             shlex.quote(
@@ -69,30 +65,30 @@ def plan_commands(args):
                 "pip install tensorflowonspark-tpu".format(url=spark_url)
             ),
         ),
-        # 3. master on host 0
+        "{} scp examples/mnist/mnist_spark.py {}:~/ --zone {} --worker=0".format(
+            tpu, args.name, args.zone
+        ),
+        # 3. master on host 0; capture its internal IP for the workers (TPU VM
+        #    hostnames are slice-specific — never hardcode them). The plan is
+        #    ONE shell session, so $MASTER_IP persists to the next steps.
         "{} ssh {} --worker=0 --command {}".format(
             tpu, target,
             shlex.quote("$HOME/{t}/sbin/start-master.sh".format(t=spark_tgz)),
         ),
+        "MASTER_IP=$({} ssh {} --worker=0 --command {})".format(
+            tpu, target, shlex.quote("hostname -I | cut -d' ' -f1")
+        ),
         # 4. ONE worker per TPU host, one task slot each (the framework's
         #    task-per-executor invariant; reference test/run_tests.sh:16-19
         #    used the same shape: SPARK_WORKER_INSTANCES with 1 core each)
-        "{} ssh {} {} --command {}".format(
-            tpu, target, all_hosts,
-            shlex.quote(
-                "MASTER_ADDR=$(getent hosts t1v-n-0 | awk '{{print $1}}'); "
-                "SPARK_WORKER_CORES=1 $HOME/{t}/sbin/start-worker.sh "
-                "spark://$MASTER_ADDR:7077".format(t=spark_tgz)
-            ),
+        "{} ssh {} {} --command \"SPARK_WORKER_CORES=1 "
+        "$HOME/{t}/sbin/start-worker.sh spark://$MASTER_IP:7077\"".format(
+            tpu, target, all_hosts, t=spark_tgz
         ),
-        # 5. smoke-check: submit the bundled MNIST example from host 0
-        "{} ssh {} --worker=0 --command {}".format(
-            tpu, target,
-            shlex.quote(
-                "MASTER=spark://$(hostname):7077 python -m "
-                "tensorflowonspark_tpu.examples.mnist_spark "
-                "--cluster_size {n} --epochs 1".format(n=n_hosts)
-            ),
+        # 5. smoke-check: submit the pushed MNIST example from host 0
+        "{} ssh {} --worker=0 --command \"MASTER=spark://$MASTER_IP:7077 "
+        "python ~/mnist_spark.py --cluster_size {n} --epochs 1\"".format(
+            tpu, target, n=n_hosts
         ),
     ]
     return cmds
@@ -113,13 +109,16 @@ def main(argv=None):
     try:
         for cmd in cmds:
             print(cmd)
-            if args.mode == "apply":
-                rc = subprocess.call(cmd, shell=True)
-                if rc != 0:
-                    print("command failed (rc={}); stopping".format(rc), file=sys.stderr)
-                    return rc
     except BrokenPipeError:  # plan piped into head etc.
-        pass
+        return 0
+    if args.mode == "apply":
+        # one shell session for the whole plan: step 4's $MASTER_IP is set
+        # by step "MASTER_IP=$(...)" and must persist to the next commands
+        script = "set -e\n" + "\n".join(cmds)
+        rc = subprocess.call(["bash", "-c", script])
+        if rc != 0:
+            print("bring-up failed (rc={})".format(rc), file=sys.stderr)
+            return rc
     return 0
 
 

@@ -210,6 +210,12 @@ def _child_entry(fn, tf_args, ctx, cluster_meta, error_queue_spec):
         _start_heartbeat(ctx.mgr)
         if cluster_meta.get("jax_distributed", True):
             ctx.initialize_distributed()
+        try:
+            import jax
+
+            tpu_info.validate_against_runtime(jax.local_device_count())
+        except Exception:  # validation is advisory
+            pass
         if cluster_meta.get("log_dir") and ctx.process_id == 0:
             try:
                 import jax

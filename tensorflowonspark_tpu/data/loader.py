@@ -65,6 +65,7 @@ class ImagePipeline:
         epochs=1,
         prefetch_batches=2,
         verify_crc=False,
+        drop_remainder=True,
     ):
         if not files:
             raise ValueError("no input files")
@@ -79,6 +80,10 @@ class ImagePipeline:
         self.epochs = epochs
         self.prefetch_batches = prefetch_batches
         self.verify_crc = verify_crc
+        #: training wants static shapes (XLA recompiles per shape); eval
+        #: wants every example scored — drop_remainder=False emits the short
+        #: final batch (one extra compile, complete coverage)
+        self.drop_remainder = drop_remainder
 
     def _record_stream(self):
         rng = np.random.default_rng(self.seed)
@@ -114,6 +119,17 @@ class ImagePipeline:
                     continue
 
         def producer():
+            def _emit(pool, batch):
+                parsed = list(pool.map(self.parse_fn, batch))
+                images = np.stack([p[0] for p in parsed])
+                # parse_fn's dtype is respected (uint8 parses quarter the
+                # host->device bytes; normalization then runs on device) —
+                # only f64 is narrowed
+                if images.dtype == np.float64:
+                    images = images.astype(np.float32)
+                labels = np.asarray([p[1] for p in parsed], np.int32)
+                out_q.put({"image": images, "label": labels})
+
             try:
                 with ThreadPoolExecutor(self.num_threads) as pool:
                     batch = []
@@ -122,17 +138,11 @@ class ImagePipeline:
                             return
                         batch.append(rec)
                         if len(batch) == self.batch_size:
-                            parsed = list(pool.map(self.parse_fn, batch))
-                            images = np.stack([p[0] for p in parsed])
-                            # parse_fn's dtype is respected (uint8 parses
-                            # quarter the host->device bytes; normalization
-                            # then runs on device) — only f64 is narrowed
-                            if images.dtype == np.float64:
-                                images = images.astype(np.float32)
-                            labels = np.asarray([p[1] for p in parsed], np.int32)
-                            out_q.put({"image": images, "label": labels})
+                            _emit(pool, batch)
                             batch = []
-                    # short remainder dropped: XLA wants one static shape
+                    if batch and not self.drop_remainder:
+                        _emit(pool, batch)
+                    # else: short remainder dropped (one static shape)
             except BaseException as e:  # surfaced on the consuming side
                 _final_put(e)
                 return

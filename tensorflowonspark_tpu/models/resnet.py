@@ -189,6 +189,21 @@ def make_loss_fn(model, weight_decay=1e-4, label_smoothing=0.0, normalize=None):
     return loss_fn
 
 
+def make_eval_fn(model, normalize=None):
+    """``eval_fn(params, model_state, batch) -> (correct, count)`` for the
+    reference's per-epoch top-1 eval (resnet_imagenet_main.py ran eval via
+    model.evaluate; here it is a jitted metric over the eval input path)."""
+    def eval_fn(params, model_state, batch):
+        images = batch["image"] if normalize is None else normalize(batch["image"])
+        logits = model.apply(
+            {"params": params, **model_state}, images, train=False
+        )
+        correct = jnp.sum(jnp.argmax(logits, -1) == batch["label"])
+        return correct, batch["label"].shape[0]
+
+    return eval_fn
+
+
 def make_predict_fn(model, normalize=None):
     def predict_fn(params, model_state, batch):
         images = batch["image"] if normalize is None else normalize(batch["image"])

@@ -114,6 +114,7 @@ class _Predictor:
         #: majority-signature load
         self._backlog = collections.deque()
         self._stopped = False
+        self._submit_lock = threading.Lock()
         self._thread = threading.Thread(target=self._run, name="tos-predictor", daemon=True)
         self._thread.start()
 
@@ -121,15 +122,20 @@ class _Predictor:
         """Blocking predict; thread-safe. Returns the outputs dict."""
         from concurrent.futures import Future
 
-        if self._stopped:
-            raise RuntimeError("predictor stopped")
         fut = Future()
-        self._q.put((arrays, fut))
+        # the lock orders every put against stop()'s sentinel: a submit that
+        # wins the race enqueues BEFORE the sentinel (the run thread serves
+        # it), one that loses raises — no future can be orphaned
+        with self._submit_lock:
+            if self._stopped:
+                raise RuntimeError("predictor stopped")
+            self._q.put((arrays, fut))
         return fut.result()
 
     def stop(self):
-        self._stopped = True
-        self._q.put(self._stop)
+        with self._submit_lock:
+            self._stopped = True
+            self._q.put(self._stop)
         self._thread.join(timeout=10)
         # fail any request that was still queued so no caller blocks forever
         # on a future that will never resolve
@@ -299,6 +305,16 @@ class InferenceServer:
     def _handle_conn(self, conn):
         with self._conns_lock:
             self._conns.add(conn)
+        # close the race with stop(): registration above + this check means
+        # any connection either appears in stop()'s snapshot or observes the
+        # shutdown flag here — no handler can survive blocked in recv()
+        if self._shutdown.is_set():
+            try:
+                conn.close()
+            finally:
+                with self._conns_lock:
+                    self._conns.discard(conn)
+            return
         msock = MessageSocket(conn)
         try:
             while True:
@@ -582,6 +598,8 @@ def main(argv=None):
         server_addr = None
         if args.server is not None:
             host, _, port = args.server.rpartition(":")
+            if not port.isdigit():
+                infer_p.error("--server must be HOST:PORT, got {!r}".format(args.server))
             server_addr = (host or "127.0.0.1", int(port))
         total = run_batch_inference(
             args.tfrecords, args.export_dir, args.output,

@@ -22,25 +22,31 @@ logger = logging.getLogger(__name__)
 ENV_CHIP_COUNT = "TOS_TPU_CHIPS_PER_HOST"
 ENV_ACCEL_TYPE = "TOS_TPU_ACCELERATOR_TYPE"
 
-#: accelerator type → (chips per host, total chips) for common Cloud TPU slices
-_KNOWN_TOPOLOGIES = {
-    "v4-8": (4, 4),
-    "v4-16": (4, 8),
-    "v4-32": (4, 16),
-    "v5e-1": (1, 1),
-    "v5e-4": (4, 4),
-    "v5e-8": (8, 8),
-    "v5e-16": (4, 16),
-    "v5e-32": (4, 32),
-    "v5e-64": (4, 64),
-    "v5e-128": (4, 128),
-    "v5e-256": (4, 256),
-    "v5p-8": (4, 4),
-    "v5p-16": (4, 8),
-    "v6e-8": (8, 8),
-    "v6e-16": (4, 16),
-    "v6e-32": (4, 32),
+#: accelerator generation → (what the "-N" suffix counts, cores per chip,
+#: max chips per host machine). Cloud TPU naming: core-counted generations
+#: (v2..v4, v5p) say "v4-32" = 32 TensorCores = 16 chips; chip-counted
+#: generations (v5e/v5litepod, v6e) say "v5e-32" = 32 chips. Rule-based so
+#: ANY slice size derives (round-2 review: a fixed table stopped at v5p-16).
+_GENERATIONS = {
+    "v2": ("cores", 2, 4),
+    "v3": ("cores", 2, 4),
+    "v4": ("cores", 2, 4),
+    "v5p": ("cores", 2, 4),
+    "v5e": ("chips", 1, 8),
+    "v5litepod": ("chips", 1, 8),
+    "v6e": ("chips", 1, 8),
 }
+
+
+def parse_accelerator_type(accel_type):
+    """``'v5e-32'`` → ``('v5e', 32)``; None for unparseable strings."""
+    if not accel_type or "-" not in accel_type:
+        return None
+    gen, _, num = accel_type.partition("-")
+    gen = gen.lower()
+    if gen not in _GENERATIONS or not num.isdigit() or int(num) < 1:
+        return None
+    return gen, int(num)
 
 
 def detect_local_chips():
@@ -85,8 +91,52 @@ def accelerator_type():
 
 
 def topology_for(accel_type):
-    """(chips_per_host, total_chips) for a known accelerator type, else None."""
-    return _KNOWN_TOPOLOGIES.get(accel_type)
+    """(chips_per_host, total_chips) derived from the accelerator type, else
+    None. Single-host slices put all chips on one machine; multi-host
+    slices use the generation's per-host chip count (4 for core-counted
+    generations, and for chip-counted ones past the 8-chip host boundary)."""
+    parsed = parse_accelerator_type(accel_type)
+    if parsed is None:
+        return None
+    gen, num = parsed
+    unit, cores_per_chip, host_max = _GENERATIONS[gen]
+    total_chips = num // cores_per_chip if unit == "cores" else num
+    total_chips = max(total_chips, 1)
+    if total_chips <= host_max:
+        return (total_chips, total_chips)
+    # multi-host: v5e/v6e multi-host slices are built from 4-chip hosts
+    per_host = 4 if unit == "chips" else min(host_max, total_chips)
+    return (per_host, total_chips)
+
+
+def num_hosts_for(accel_type):
+    """Host (worker VM) count for a slice, or None — what the launch tooling
+    sizes ``--cluster_size`` with."""
+    topo = topology_for(accel_type)
+    if topo is None:
+        return None
+    per_host, total = topo
+    return max(1, total // per_host)
+
+
+def validate_against_runtime(local_device_count):
+    """Compare the env/device-file detection against what the runtime
+    actually sees (called from the jax child once jax is up). Logs — never
+    raises — because detection feeds placement hints, not correctness.
+
+    Core-counted generations (v2/v3) expose 2 devices per chip, so a
+    runtime count of exactly 2x the detected chips is also a match."""
+    detected = detect_local_chips()
+    if not detected or not local_device_count:
+        return True
+    if local_device_count in (detected, 2 * detected):
+        return True
+    logger.warning(
+        "tpu_info detected %d local chip(s) but the runtime reports %d "
+        "local device(s); trusting the runtime (override with %s)",
+        detected, local_device_count, ENV_CHIP_COUNT,
+    )
+    return False
 
 
 def local_topology():
@@ -95,8 +145,10 @@ def local_topology():
     the reservation server's role grows to include TPU topology exchange)."""
     accel = accelerator_type()
     chips = detect_local_chips()
-    if chips == 0 and accel and accel in _KNOWN_TOPOLOGIES:
-        chips = _KNOWN_TOPOLOGIES[accel][0]
+    if chips == 0 and accel:
+        derived = topology_for(accel)
+        if derived:
+            chips = derived[0]
     return {
         "accelerator_type": accel,
         "num_chips": chips,

@@ -129,11 +129,13 @@ def test_resnet_imagenet_real_data_end_to_end(tmp_path):
     )
     out = _run(
         "resnet/resnet_spark.py", "--dataset", "imagenet", "--data_dir", data,
+        "--eval_dir", data,
         "--train_steps", "4", "--batch_size", "8", "--log_steps", "2",
         "--steps_per_loop", "2", "--image_size", "48", "--dtype", "fp32",
         "--model_dir", model_dir, "--platform", "cpu", timeout=600,
     )
     assert "resnet training complete" in out
+    assert "eval accuracy" in out  # the eval input path ran end to end
     assert os.path.isdir(os.path.join(model_dir, "ckpt_4"))
 
 

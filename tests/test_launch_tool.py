@@ -4,7 +4,8 @@ requires gcloud and runs only in the field."""
 
 import argparse
 
-from scripts.launch_tpu_spark import HOSTS, plan_commands
+from scripts.launch_tpu_spark import plan_commands
+from tensorflowonspark_tpu import tpu_info
 
 
 def _args(**kw):
@@ -19,14 +20,19 @@ def _args(**kw):
 
 def test_plan_shape_and_order():
     cmds = plan_commands(_args())
-    assert len(cmds) == 5
+    assert len(cmds) == 7
     assert "tpu-vm create tos --zone us-central2-b" in cmds[0]
     assert "--accelerator-type v5e-32" in cmds[0]
     assert "spark-3.5.1-bin-hadoop3" in cmds[1] and "--worker=all" in cmds[1]
-    assert "start-master.sh" in cmds[2] and "--worker=0" in cmds[2]
+    assert "scp examples/mnist/mnist_spark.py" in cmds[2]
+    assert "start-master.sh" in cmds[3] and "--worker=0" in cmds[3]
+    # master IP resolved from host 0, never a hardcoded slice hostname
+    assert cmds[4].startswith("MASTER_IP=$(") and "hostname -I" in cmds[4]
     # one worker per host, ONE core each: the task-per-executor invariant
-    assert "SPARK_WORKER_CORES=1" in cmds[3] and "--worker=all" in cmds[3]
-    assert "--cluster_size 4" in cmds[4]  # v5e-32 = 4 TPU hosts
+    assert "SPARK_WORKER_CORES=1" in cmds[5] and "--worker=all" in cmds[5]
+    assert "spark://$MASTER_IP:7077" in cmds[5]
+    assert "--cluster_size 8" in cmds[6]  # v5e-32 = 8 hosts x 4 chips
+    assert "mnist_spark.py" in cmds[6]
 
 
 def test_teardown_plan():
@@ -41,6 +47,8 @@ def test_unknown_accelerator_fails_loudly():
         plan_commands(_args(accelerator="v99-1"))
 
 
-def test_host_table_consistency():
-    assert HOSTS["v5e-32"] == 4
-    assert all(isinstance(v, int) and v >= 1 for v in HOSTS.values())
+def test_host_counts_from_topology_rules():
+    assert tpu_info.num_hosts_for("v5e-32") == 8
+    assert tpu_info.num_hosts_for("v5p-128") == 16
+    cmds = plan_commands(_args(accelerator="v5p-128"))
+    assert "--cluster_size 16" in cmds[6]
