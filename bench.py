@@ -274,15 +274,21 @@ def _mnist_bench_fun(args, ctx):
     optimizer = optax.sgd(0.1)
     state = strategy.create_state(mnist.make_init_fn(model), optimizer, jax.random.PRNGKey(0))
     step = strategy.compile_train_step(mnist.make_loss_fn(model), optimizer, has_aux=True)
-    feed = ctx.get_data_feed(train_mode=True)
+    # input_mapping + as_numpy: the columnar fast lane (shm column slices
+    # straight into device-put-ready arrays — same consumption shape as the
+    # ML pipeline's sorted-input-cols feed)
+    feed = ctx.get_data_feed(
+        train_mode=True, input_mapping={"c0": "image", "c1": "label"}
+    )
     bs = args["batch_size"]
     while not feed.should_stop():
-        batch = feed.next_batch(bs)
-        if len(batch) < bs:
+        batch = feed.next_batch(bs, as_numpy=True)
+        if len(batch["label"]) < bs:
             break
-        images = np.asarray([b[0] for b in batch], np.float32).reshape(-1, 28, 28)
-        labels = np.asarray([b[1] for b in batch])
-        state, metrics = step(state, strategy.shard_batch({"image": images, "label": labels}))
+        images = np.asarray(batch["image"], np.float32).reshape(-1, 28, 28)
+        state, metrics = step(
+            state, strategy.shard_batch({"image": images, "label": batch["label"]})
+        )
         jax.block_until_ready(metrics["loss"])
 
 

@@ -131,15 +131,32 @@ def main_fun(args, ctx):
         )
         batches = iter(lambda: synthetic, None)  # repeat forever
 
+    profile_range = None
+    if args.profile_steps:
+        # reference: --profile_steps -> profiler callback over a step range
+        # (common.py:192-197); here the jax profiler traces the same range
+        lo, _, hi = args.profile_steps.partition(",")
+        profile_range = (int(lo), int(hi or lo))
+
     t0, metrics = time.perf_counter(), {}
     i = last_log = 0
+    profiling = False
     while i < args.train_steps:
+        if profile_range and not profiling and i >= profile_range[0]:
+            trace_dir = os.path.join(args.model_dir or ".", "profile")
+            jax.profiler.start_trace(trace_dir)
+            profiling = True
         if steps_per_loop > 1 and i + steps_per_loop <= args.train_steps:
             state, metrics = loop(state, [next(batches) for _ in range(steps_per_loop)])
             i += steps_per_loop
         else:
             state, metrics = step(state, next(batches))
             i += 1
+        if profiling and i >= profile_range[1]:
+            jax.block_until_ready(metrics["loss"])
+            jax.profiler.stop_trace()
+            profiling = False
+            print("profiler trace written to {}".format(trace_dir))
         if i - last_log >= args.log_steps:
             jax.block_until_ready(metrics["loss"])
             dt = time.perf_counter() - t0
@@ -147,6 +164,11 @@ def main_fun(args, ctx):
             print("step {}: loss {:.3f} {:.1f} img/s".format(
                 i, float(metrics["loss"]), args.batch_size * (i - last_log) / dt))
             last_log, t0 = i, time.perf_counter()
+    if profiling:
+        # a stop boundary past train_steps must still flush the trace
+        jax.block_until_ready(metrics["loss"])
+        jax.profiler.stop_trace()
+        print("profiler trace written to {}".format(trace_dir))
     if metrics:
         jax.block_until_ready(metrics["loss"])
         print("final loss {:.3f}".format(float(metrics["loss"])))
@@ -216,6 +238,9 @@ def main(argv=None):
                         help=">1 fuses that many train steps into one device "
                              "dispatch (lax.scan)")
     parser.add_argument("--model_dir", default=None)
+    parser.add_argument("--profile_steps", default=None, metavar="START[,STOP]",
+                        help="capture a jax profiler trace over this step range "
+                             "(reference --profile_steps, common.py:192-197)")
     parser.add_argument("--steps_per_epoch", type=int, default=390)
     parser.add_argument("--train_steps", type=int, default=100)
     parser.add_argument("--use_synthetic_data", action="store_true", default=False,

@@ -65,8 +65,11 @@ def plan_commands(args):
                 "pip install tensorflowonspark-tpu".format(url=spark_url)
             ),
         ),
-        "{} scp examples/mnist/mnist_spark.py {}:~/ --zone {} --worker=0".format(
-            tpu, args.name, args.zone
+        "{} scp {} {}:~/ --zone {} --worker=0".format(
+            tpu,
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "examples", "mnist", "mnist_spark.py"),
+            args.name, args.zone,
         ),
         # 3. master on host 0; capture its internal IP for the workers (TPU VM
         #    hostnames are slice-specific — never hardcode them). The plan is
@@ -81,8 +84,10 @@ def plan_commands(args):
         # 4. ONE worker per TPU host, one task slot each (the framework's
         #    task-per-executor invariant; reference test/run_tests.sh:16-19
         #    used the same shape: SPARK_WORKER_INSTANCES with 1 core each)
+        # \$HOME stays literal through the local shell (expands on the TPU
+        # host where Spark was installed); $MASTER_IP expands locally
         "{} ssh {} {} --command \"SPARK_WORKER_CORES=1 "
-        "$HOME/{t}/sbin/start-worker.sh spark://$MASTER_IP:7077\"".format(
+        "\\$HOME/{t}/sbin/start-worker.sh spark://$MASTER_IP:7077\"".format(
             tpu, target, all_hosts, t=spark_tgz
         ),
         # 5. smoke-check: submit the pushed MNIST example from host 0

@@ -29,6 +29,37 @@ def _is_shm_chunk(item):
     return isinstance(item, ShmChunk)
 
 
+class _Block:
+    """Marks a multi-row columnar slice inside a per-tensor accumulator (the
+    as_numpy+mapping fast lane appends these instead of scalars)."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = arr
+
+
+def _merge_column(entries):
+    """Assemble one output column from a mix of per-row values and
+    :class:`_Block` slices, preserving order."""
+    import numpy as np
+
+    if not any(isinstance(e, _Block) for e in entries):
+        return np.asarray(entries)
+    parts, scalars = [], []
+    for e in entries:
+        if isinstance(e, _Block):
+            if scalars:
+                parts.append(np.asarray(scalars))
+                scalars = []
+            parts.append(np.asarray(e.arr))
+        else:
+            scalars.append(e)
+    if scalars:
+        parts.append(np.asarray(scalars))
+    return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+
 def _all_numpy(rows):
     """True when every row (and every field of tuple rows) is a numpy value —
     the precondition for type-faithful shared-memory results."""
@@ -142,6 +173,9 @@ class DataFeed:
         #: rows unwrapped from a partially-consumed Chunk, served before the
         #: next proxied queue get (the consumer half of feed-plane chunking)
         self._pending = collections.deque()
+        #: a partially-consumed ShmChunk kept COLUMNAR: (columns, single,
+        #: cursor, total) — the fast lane for as_numpy+mapping consumers
+        self._cols = None
         #: a dequeued Chunk whose task_done is deferred until every row is
         #: consumed — keeps the feeder's unfinished()==0 wait meaning "all
         #: rows trained", not "all messages dequeued"
@@ -154,12 +188,16 @@ class DataFeed:
         dict of columns keyed by tensor name. ``as_numpy=True`` stacks columns
         into numpy arrays (device-put ready). One proxied queue get fetches a
         whole :class:`~tensorflowonspark_tpu.marker.Chunk` of rows (vs the
-        reference's one-round-trip-per-row loop, TFNode.py:243-288).
+        reference's one-round-trip-per-row loop, TFNode.py:243-288); a
+        shared-memory chunk consumed by an ``as_numpy`` + ``input_mapping``
+        consumer moves COLUMN SLICES, never Python rows — the near-zero-copy
+        path from feeder numpy straight to ``jax.device_put``.
         """
         logger.debug("next_batch(%d)", batch_size)
         queue_in = self.mgr.get_queue(self.qname_in)
         tensors = [] if self.input_tensors is None else {t: [] for t in self.input_tensors}
         count = 0
+        columnar_ok = as_numpy and self.input_tensors is not None
 
         def _consume(row):
             if self.input_tensors is None:
@@ -168,7 +206,37 @@ class DataFeed:
                 for i, t in enumerate(self.input_tensors):
                     tensors[t].append(row[i])
 
+        def _segment_done():
+            self._cols = None
+            if self._chunk_open:
+                queue_in.task_done()
+                self._chunk_open = False
+
+        def _take_columnar(need):
+            cols, single, cursor, total = self._cols
+            n = min(need, total - cursor)
+            if columnar_ok and not single and len(cols) == len(self.input_tensors):
+                # fast lane: one slice per tensor per chunk (no row objects)
+                for i, t in enumerate(self.input_tensors):
+                    tensors[t].append(_Block(cols[i][cursor : cursor + n]))
+            else:
+                slices = [c[cursor : cursor + n] for c in cols]
+                if not as_numpy:
+                    slices = [s.tolist() for s in slices]
+                rows = list(slices[0]) if single else list(zip(*slices))
+                for row in rows:
+                    _consume(row)
+            cursor += n
+            if cursor >= total:
+                _segment_done()
+            else:
+                self._cols = (cols, single, cursor, total)
+            return n
+
         while count < batch_size:
+            if self._cols is not None:
+                count += _take_columnar(batch_size - count)
+                continue
             if self._pending:
                 _consume(self._pending.popleft())
                 count += 1
@@ -189,20 +257,21 @@ class DataFeed:
                 queue_in.task_done()
                 if count > 0:
                     break
-            elif isinstance(item, Chunk) or _is_shm_chunk(item):
-                # pickled chunk or shared-memory descriptor (the latter's
-                # payload never crossed the Manager socket); either way
-                # task_done is deferred until the last row is consumed.
-                # Numpy consumers get zero-ish-copy numpy rows; plain
-                # consumers get Python-typed rows (tolist) so the shm lane
-                # never changes the types user code observes.
-                if isinstance(item, Chunk):
-                    rows = item.items
-                else:
-                    rows = item.rows() if as_numpy else item.py_rows()
-                self._pending.extend(rows)
+            elif isinstance(item, Chunk):
+                # pickled chunk: rows as the feeder sent them; task_done
+                # deferred until the last row is consumed
+                self._pending.extend(item.items)
                 self._chunk_open = bool(self._pending)
                 if not self._pending:  # defensive: empty chunk
+                    queue_in.task_done()
+            elif _is_shm_chunk(item):
+                # shared-memory descriptor: payload never crossed the
+                # Manager socket; keep it columnar and slice batches out
+                cols = item.materialize()
+                if item.count:
+                    self._cols = (cols, item.single, 0, item.count)
+                    self._chunk_open = True
+                else:
                     queue_in.task_done()
             else:
                 _consume(item)
@@ -214,7 +283,7 @@ class DataFeed:
 
             if self.input_tensors is None:
                 return np.asarray(tensors)
-            return {t: np.asarray(col) for t, col in tensors.items()}
+            return {t: _merge_column(col) for t, col in tensors.items()}
         return tensors
 
     def should_stop(self):

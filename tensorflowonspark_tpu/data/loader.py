@@ -49,9 +49,10 @@ class ImagePipeline:
 
     ``parse_fn(record_bytes) -> (image, label)`` comes from
     :mod:`~tensorflowonspark_tpu.data.imagenet` / ``cifar``. Iterating yields
-    ``steps_per_epoch * epochs`` batches (``epochs=None`` repeats forever);
-    short final batches are dropped (static shapes for XLA, the reference's
-    ``drop_remainder=True``).
+    ``steps_per_epoch * epochs`` batches (``epochs=None`` repeats forever).
+    By default short final batches are dropped (static shapes for XLA, the
+    reference's ``drop_remainder=True``); pass ``drop_remainder=False`` for
+    complete-coverage eval (one extra compile for the short batch).
     """
 
     def __init__(

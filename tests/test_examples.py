@@ -86,13 +86,19 @@ def test_segmentation_spark(tmp_path):
 
 
 @pytest.mark.slow
-def test_resnet_cifar_synthetic():
+def test_resnet_cifar_synthetic(tmp_path):
+    model_dir = str(tmp_path / "prof")
     out = _run(
-        "resnet/resnet_spark.py", "--dataset", "cifar", "--train_steps", "2",
+        "resnet/resnet_spark.py", "--dataset", "cifar", "--train_steps", "3",
         "--batch_size", "8", "--log_steps", "1", "--dtype", "fp32",
-        "--platform", "cpu",
+        "--platform", "cpu", "--model_dir", model_dir,
+        "--profile_steps", "1,2",
     )
     assert "resnet training complete" in out
+    # the profiler trace landed (reference --profile_steps parity)
+    assert "profiler trace written" in out
+    prof = os.path.join(model_dir, "profile")
+    assert os.path.isdir(prof) and os.listdir(prof)
 
 
 @pytest.mark.slow

@@ -24,7 +24,8 @@ def test_plan_shape_and_order():
     assert "tpu-vm create tos --zone us-central2-b" in cmds[0]
     assert "--accelerator-type v5e-32" in cmds[0]
     assert "spark-3.5.1-bin-hadoop3" in cmds[1] and "--worker=all" in cmds[1]
-    assert "scp examples/mnist/mnist_spark.py" in cmds[2]
+    # absolute path anchored at the repo, not the operator's CWD
+    assert " scp /" in cmds[2] and cmds[2].split()[5].endswith("examples/mnist/mnist_spark.py")
     assert "start-master.sh" in cmds[3] and "--worker=0" in cmds[3]
     # master IP resolved from host 0, never a hardcoded slice hostname
     assert cmds[4].startswith("MASTER_IP=$(") and "hostname -I" in cmds[4]

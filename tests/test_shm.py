@@ -125,6 +125,37 @@ def test_datafeed_consumes_shm_chunks():
         mgr.shutdown()
 
 
+def test_datafeed_columnar_fast_lane_slices_across_boundaries():
+    """as_numpy+mapping consumers get column SLICES (no row objects) even
+    when batch boundaries cut through shm chunks, with correct ordering and
+    values across chunk joins; a pickled Chunk interleaved mid-stream merges
+    into the same output columns."""
+    from tensorflowonspark_tpu import TFManager
+    from tensorflowonspark_tpu.TFNode import DataFeed
+    from tensorflowonspark_tpu.marker import Chunk
+
+    mgr = TFManager.start(b"shm-colfast", ["input", "output"], mode="local")
+    try:
+        q = mgr.get_queue("input")
+        rows = [([float(i)] * 3, i) for i in range(10)]
+        q.put(ShmChunk.from_rows(rows[:6]))
+        q.put(Chunk(rows[6:8]))  # pickled rows interleave
+        q.put(ShmChunk.from_rows(rows[8:]))
+        q.put(None)
+        feed = DataFeed(mgr, train_mode=False, input_mapping={"a": "x", "b": "y"})
+        b1 = feed.next_batch(4, as_numpy=True)   # slice of chunk 1
+        b2 = feed.next_batch(5, as_numpy=True)   # chunk1 tail + pickled + chunk2 head
+        b3 = feed.next_batch(4, as_numpy=True)   # chunk2 tail + end-of-feed
+        assert b1["x"].shape == (4, 3) and b2["x"].shape == (5, 3) and b3["x"].shape == (1, 3)
+        got = np.concatenate([b["y"] for b in (b1, b2, b3)])
+        np.testing.assert_array_equal(got, np.arange(10))
+        np.testing.assert_allclose(b2["x"][0], [4.0] * 3)
+        assert feed.should_stop()
+        assert q.unfinished() == 0
+    finally:
+        mgr.shutdown()
+
+
 def test_datafeed_plain_consumer_gets_python_types():
     """Without as_numpy, the shm lane delivers the exact Python types the
     feeder saw — no silent list→ndarray / int→np.int64 changes inside user
