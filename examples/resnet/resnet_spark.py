@@ -156,6 +156,7 @@ def main_fun(args, ctx):
             jax.block_until_ready(metrics["loss"])
             jax.profiler.stop_trace()
             profiling = False
+            profile_range = None  # captured once; never re-trigger
             print("profiler trace written to {}".format(trace_dir))
         if i - last_log >= args.log_steps:
             jax.block_until_ready(metrics["loss"])

@@ -67,8 +67,10 @@ def plan_commands(args):
         ),
         "{} scp {} {}:~/ --zone {} --worker=0".format(
             tpu,
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "..", "examples", "mnist", "mnist_spark.py"),
+            shlex.quote(os.path.normpath(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "..", "examples", "mnist", "mnist_spark.py",
+            ))),
             args.name, args.zone,
         ),
         # 3. master on host 0; capture its internal IP for the workers (TPU VM
