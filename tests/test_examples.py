@@ -146,6 +146,25 @@ def test_resnet_imagenet_real_data_end_to_end(tmp_path):
 
 
 @pytest.mark.slow
+def test_transformer_example_sharded(tmp_path):
+    """The flagship example: LM training over a dp x tp x sp mesh (tensor
+    parallelism + ring attention) with the fused train loop, then a
+    checkpoint lands."""
+    model_dir = str(tmp_path / "lm")
+    out = _run(
+        "transformer/transformer_spark.py", "--cluster_size", "1",
+        "--train_steps", "4", "--steps_per_loop", "2", "--log_steps", "2",
+        "--batch_size", "4", "--seq_len", "64", "--d_model", "64",
+        "--n_layers", "2", "--n_heads", "4", "--d_ff", "128",
+        "--dtype", "float32", "--mesh", "dp=2,tp=2,sp=2",
+        "--model_dir", model_dir, "--platform", "cpu", timeout=600,
+    )
+    assert "transformer training complete" in out
+    assert "'tp': 2" in out and "'sp': 2" in out
+    assert os.path.isdir(os.path.join(model_dir, "ckpt_4"))
+
+
+@pytest.mark.slow
 def test_mnist_pipeline_then_parallel_inference(tmp_path):
     """The remaining two BASELINE mnist configs at example level: the
     Spark-ML pipeline (TFEstimator fit -> bundle -> TFModel transform) and
