@@ -1,0 +1,114 @@
+package com.tensorflowonspark.tpu;
+
+import java.io.BufferedInputStream;
+import java.io.BufferedOutputStream;
+import java.io.EOFException;
+import java.io.IOException;
+import java.io.InputStream;
+import java.io.OutputStream;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.util.ArrayList;
+import java.util.List;
+import java.util.zip.CRC32C;
+
+/**
+ * Dependency-free TFRecord framing for JVM Spark jobs — the
+ * {@code DFUtil.scala} capability (JVM-side TFRecord IO, reference
+ * DFUtil.scala:35-119) without libtensorflow or the tensorflow-hadoop jar.
+ *
+ * Wire format (pinned byte-level by the Python twin
+ * {@code tensorflowonspark_tpu/tfrecord.py} and its tests):
+ * little-endian u64 length, masked CRC32C of the length bytes,
+ * payload, masked CRC32C of the payload. The mask is
+ * {@code ((crc >>> 15) | (crc << 17)) + 0xa282ead8}.
+ *
+ * Records are raw bytes; pair with your protobuf/Example decoder of choice
+ * (or ship features through {@link InferenceClient} and let the Python side
+ * decode). Typical Spark usage: read shards in {@code mapPartitions} from
+ * HDFS/GCS streams, batch, call {@code predictBinary}.
+ */
+public final class TFRecordIO {
+
+  private static final long MASK_DELTA = 0xa282ead8L;
+
+  private TFRecordIO() {}
+
+  static int maskedCrc(byte[] data, int off, int len) {
+    CRC32C crc = new CRC32C();
+    crc.update(data, off, len);
+    long c = crc.getValue();
+    long masked = (((c >>> 15) | (c << 17)) + MASK_DELTA) & 0xffffffffL;
+    return (int) masked;
+  }
+
+  /** Read every record of one shard from a stream (e.g. HDFS/GCS open()). */
+  public static List<byte[]> readAll(InputStream raw, boolean verifyCrc) throws IOException {
+    InputStream in = raw instanceof BufferedInputStream ? raw : new BufferedInputStream(raw);
+    List<byte[]> out = new ArrayList<>();
+    byte[] header = new byte[12];
+    while (true) {
+      int first = in.read();
+      if (first < 0) {
+        return out;  // clean EOF at a record boundary
+      }
+      header[0] = (byte) first;
+      readFully(in, header, 1, 11);
+      ByteBuffer hb = ByteBuffer.wrap(header).order(ByteOrder.LITTLE_ENDIAN);
+      long length = hb.getLong(0);
+      int lengthCrc = hb.getInt(8);
+      if (length < 0 || length > Integer.MAX_VALUE - 16) {
+        throw new IOException("corrupt record length " + length);
+      }
+      if (verifyCrc && maskedCrc(header, 0, 8) != lengthCrc) {
+        throw new IOException("corrupt length crc at record " + out.size());
+      }
+      byte[] payload = new byte[(int) length];
+      readFully(in, payload, 0, payload.length);
+      byte[] footer = new byte[4];
+      readFully(in, footer, 0, 4);
+      if (verifyCrc) {
+        int payloadCrc = ByteBuffer.wrap(footer).order(ByteOrder.LITTLE_ENDIAN).getInt(0);
+        if (maskedCrc(payload, 0, payload.length) != payloadCrc) {
+          throw new IOException("corrupt payload crc at record " + out.size());
+        }
+      }
+      out.add(payload);
+    }
+  }
+
+  /** Append one framed record to a stream. */
+  public static void write(OutputStream raw, byte[] record) throws IOException {
+    OutputStream out = raw;
+    ByteBuffer hb = ByteBuffer.allocate(12).order(ByteOrder.LITTLE_ENDIAN);
+    hb.putLong(0, record.length);
+    byte[] header = hb.array();
+    hb.putInt(8, maskedCrc(header, 0, 8));
+    out.write(header, 0, 12);
+    out.write(record);
+    ByteBuffer fb = ByteBuffer.allocate(4).order(ByteOrder.LITTLE_ENDIAN);
+    fb.putInt(0, maskedCrc(record, 0, record.length));
+    out.write(fb.array(), 0, 4);
+  }
+
+  /** Write a whole shard (buffered; caller closes the stream). */
+  public static void writeAll(OutputStream raw, Iterable<byte[]> records) throws IOException {
+    BufferedOutputStream out =
+        raw instanceof BufferedOutputStream ? (BufferedOutputStream) raw : new BufferedOutputStream(raw);
+    for (byte[] rec : records) {
+      write(out, rec);
+    }
+    out.flush();
+  }
+
+  private static void readFully(InputStream in, byte[] buf, int off, int len) throws IOException {
+    int done = 0;
+    while (done < len) {
+      int n = in.read(buf, off + done, len - done);
+      if (n < 0) {
+        throw new EOFException("truncated record (wanted " + len + " bytes, got " + done + ")");
+      }
+      done += n;
+    }
+  }
+}
