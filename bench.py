@@ -12,6 +12,10 @@ Modes (BENCH_MODE env):
   metric 1 including the input pipeline.
 * ``resnet`` — same model/step on synthetic device-resident batches
   (no input pipeline, no H2D): the device-ceiling comparison number.
+* ``lm`` — transformer LM training tokens/sec/chip (flash attention,
+  seq ``BENCH_SEQ`` default 4096, bf16): the beyond-parity flagship.
+* ``feed_plane`` — pure feed-plane rows/sec (shm lane vs pickled chunks),
+  ResNet- and MNIST-shaped rows, no Spark shipping or training.
 * ``mnist_epoch`` — BASELINE.json metric 2, "MNIST epoch time
   (InputMode.SPARK)": wall-clock seconds to push one epoch of MNIST-shaped
   rows through a live 1-worker cluster's feed plane (reservation server,
@@ -32,7 +36,7 @@ runs reach 30-50% MXU utilization; beating 0.7x of this constant is the
 floor, not the ceiling).
 
 Env knobs: BENCH_TINY=1 (CPU-friendly shapes), BENCH_BATCH, BENCH_STEPS,
-BENCH_MNIST_ROWS.
+BENCH_MNIST_ROWS, BENCH_SEQ, BENCH_FUSED, BENCH_PACKED, BENCH_DATA_THREADS.
 """
 
 import json
@@ -339,6 +343,69 @@ def bench_mnist_epoch():
     }
 
 
+def bench_lm(tiny):
+    """Transformer LM training throughput, tokens/sec/chip — the
+    beyond-parity flagship (flash attention at long context): fwd+bwd+adamw
+    on synthetic tokens, bf16, seq BENCH_SEQ (default 4096; by 8192 plain
+    XLA attention fails to compile the score matrix outright — docs/perf.md). vs_baseline is MXU utilization: achieved model FLOP/s
+    (6 * params * tokens/s) over the chip's bf16 peak."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import parallel
+    from tensorflowonspark_tpu.models import transformer
+    from tensorflowonspark_tpu.train import SyncDataParallel
+
+    n_chips = jax.device_count()
+    seq = int(os.environ.get("BENCH_SEQ", 64 if tiny else 4096))
+    batch = int(os.environ.get("BENCH_BATCH", 2 if tiny else 4)) * n_chips
+    steps = int(os.environ.get("BENCH_STEPS", 2 if tiny else 10))
+    mesh = parallel.build_mesh({"dp": n_chips})
+    strategy = SyncDataParallel(mesh)
+    model = transformer.create_model(
+        mesh=mesh,
+        vocab_size=1024 if tiny else 32000,
+        d_model=64 if tiny else 1024,
+        n_layers=2 if tiny else 4,
+        n_heads=4 if tiny else 16,
+        d_ff=128 if tiny else 4096,
+        max_seq_len=seq, dtype="float32" if tiny else "bfloat16",
+    )
+    optimizer = optax.adamw(1e-4)
+    state = strategy.create_state(
+        transformer.make_init_fn(model, sample_len=8), optimizer, jax.random.PRNGKey(0)
+    )
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(state.params))
+    step = strategy.compile_train_step(
+        transformer.make_loss_fn(model), optimizer, has_aux=True
+    )
+    rng = np.random.default_rng(0)
+    sharded = strategy.shard_batch(
+        {"tokens": rng.integers(0, 1000, (batch, seq + 1))}
+    )
+    for _ in range(2):
+        state, metrics = step(state, sharded)
+    float(np.asarray(jax.device_get(metrics["loss"])))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, sharded)
+    float(np.asarray(jax.device_get(metrics["loss"])))
+    dt = time.perf_counter() - t0
+    tokens_s = batch * seq * steps / dt / n_chips
+    # 6*N FLOPs per token (fwd+bwd), v5e bf16 peak 197 TFLOP/s
+    mxu_util = 6.0 * n_params * tokens_s / 197e12
+    return {
+        "metric": "transformer_lm_tokens_per_sec_per_chip",
+        "value": round(tokens_s, 1),
+        "unit": "tokens/sec/chip (seq {}, {:.0f}M params, flash attention)".format(
+            seq, n_params / 1e6
+        ),
+        "vs_baseline": round(mxu_util, 4),
+    }
+
+
 def bench_feed_plane():
     """Pure feed-plane throughput (no Spark partition shipping, no training):
     rows pushed through a live executor IPC channel by a producer process
@@ -417,6 +484,8 @@ def main():
         result = bench_mnist_epoch()
     elif mode == "feed_plane":
         result = bench_feed_plane()
+    elif mode == "lm":
+        result = bench_lm(tiny)
     else:
         result = bench_resnet(tiny, real_data=(mode != "resnet"))
     print(json.dumps(result))

@@ -78,8 +78,7 @@ public final class TFRecordIO {
   }
 
   /** Append one framed record to a stream. */
-  public static void write(OutputStream raw, byte[] record) throws IOException {
-    OutputStream out = raw;
+  public static void write(OutputStream out, byte[] record) throws IOException {
     ByteBuffer hb = ByteBuffer.allocate(12).order(ByteOrder.LITTLE_ENDIAN);
     hb.putLong(0, record.length);
     byte[] header = hb.array();

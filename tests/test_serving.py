@@ -245,6 +245,51 @@ def test_coalescing_matches_individual_runs(tmp_path):
         pred.stop()
 
 
+def test_mixed_signature_requests_all_complete(tmp_path):
+    """Minority-signature requests ride the FIFO backlog and complete under
+    sustained majority-signature load (no starvation)."""
+    import threading
+
+    from tensorflowonspark_tpu.serving import _Predictor
+    from tensorflowonspark_tpu.train import export as export_mod
+
+    predict_fn, params, model_state = export_mod.load_model(_bundle(tmp_path))
+    pred = _Predictor(predict_fn, params, model_state)
+    try:
+        outs = {}
+        errors = []
+
+        def majority(i):
+            try:
+                x = np.full((4, 2), float(i), np.float32)
+                for _ in range(10):
+                    outs[("maj", i)] = pred.submit({"x": x})
+            except Exception as e:
+                errors.append(e)
+
+        def minority():
+            try:
+                # different dtype+width signature: never coalesces with the
+                # majority stream
+                x = np.full((2, 2), 9.0, np.float64)
+                for _ in range(5):
+                    outs["min"] = pred.submit({"x": x})
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=majority, args=(i,)) for i in range(6)]
+        threads.append(threading.Thread(target=minority))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        np.testing.assert_allclose(outs["min"]["y_"], np.full((2, 1), 46.0))
+        np.testing.assert_allclose(outs[("maj", 3)]["y_"], np.full((4, 1), 16.0))
+    finally:
+        pred.stop()
+
+
 def test_batch_inference_cli(tmp_path):
     """The Inference.scala:52-79 analogue: TFRecord shards in, prediction
     shards out (VERDICT r2 item 4a)."""
