@@ -46,6 +46,7 @@ MODULES = [
     "tensorflowonspark_tpu.train.strategy",
     "tensorflowonspark_tpu.train.checkpoint",
     "tensorflowonspark_tpu.train.export",
+    "tensorflowonspark_tpu.train.metrics",
     "tensorflowonspark_tpu.data.loader",
     "tensorflowonspark_tpu.data.imagenet",
     "tensorflowonspark_tpu.data.cifar",

@@ -16,8 +16,11 @@ _EXPORTS = {
     "checkpoint": None,
     "strategy": None,
     "export": None,
+    "metrics": None,
     "export_model": "export",
     "load_model": "export",
+    "TimeHistory": "metrics",
+    "build_stats": "metrics",
 }
 
 

@@ -1,0 +1,51 @@
+"""TimeHistory / build_stats (the reference's measurement instrumentation,
+common.py:177-245, promoted from example code to a framework module)."""
+
+import numpy as np
+
+from tensorflowonspark_tpu.train import TimeHistory, build_stats
+
+
+def test_time_history_intervals_and_rate(monkeypatch):
+    clock = {"t": 100.0}
+    monkeypatch.setattr("time.time", lambda: clock["t"])
+
+    th = TimeHistory(batch_size=32, log_steps=4)
+    for _ in range(12):  # 3 complete intervals
+        th.batch_end()
+        clock["t"] += 0.5
+    assert th.global_steps == 12
+    assert len(th.timestamps) == 3
+    # avg_exp_per_second = bs * log_steps * (N-1) / (t_last - t_first):
+    # interval ends at t=101.5, 103.5, 105.5 -> 32*4*2/4 = 64
+    assert abs(th.avg_examples_per_second - 64.0) < 1e-6
+
+
+def test_time_history_too_short_run():
+    th = TimeHistory(batch_size=8, log_steps=100)
+    th.batch_end()
+    assert th.avg_examples_per_second == 0.0
+    assert th.timestamps == []
+
+
+def test_build_stats_shapes():
+    th = TimeHistory(batch_size=8, log_steps=1)
+    th.batch_end()
+    th.batch_end()
+    stats = build_stats(
+        loss=np.float32(1.5),
+        metrics={"accuracy": np.float32(0.9), "step": 10},
+        time_history=th,
+        eval_results={"accuracy": 0.8},
+    )
+    assert stats["loss"] == 1.5
+    assert stats["accuracy"] == np.float32(0.9)
+    assert stats["eval_accuracy"] == 0.8
+    assert len(stats["step_timestamp_log"]) == 2
+    assert stats["train_finish_time"] is not None
+    assert stats["avg_exp_per_second"] > 0
+
+
+def test_build_stats_minimal():
+    assert build_stats(None) == {}
+    assert build_stats(2.0) == {"loss": 2.0}
