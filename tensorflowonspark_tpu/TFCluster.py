@@ -340,6 +340,13 @@ class TFCluster:
                     errors.append("node {}:{}:\n{}".format(row["job_name"], row["task_index"], tb))
             except Exception:
                 pass
+            # drain whatever the child never consumed: shared-memory chunks
+            # in an abandoned queue would otherwise pin /dev/shm RAM until
+            # the day-scale janitor (a dead child can't unlink its segments)
+            try:
+                TFSparkNode.drain_queue(mgr, "input")
+            except Exception:
+                pass
             mgr.set("state", "stopped")
         if errors:
             raise RuntimeError("error(s) in cluster nodes:\n" + "\n".join(errors))

@@ -213,16 +213,23 @@ class DataFeed:
                 self._chunk_open = False
 
         def _take_columnar(need):
-            cols, single, cursor, total = self._cols
+            cols, single, py_cols, cursor, total = self._cols
             n = min(need, total - cursor)
             if columnar_ok and not single and len(cols) == len(self.input_tensors):
                 # fast lane: one slice per tensor per chunk (no row objects)
                 for i, t in enumerate(self.input_tensors):
                     tensors[t].append(_Block(cols[i][cursor : cursor + n]))
             else:
-                slices = [c[cursor : cursor + n] for c in cols]
-                if not as_numpy:
-                    slices = [s.tolist() for s in slices]
+                # type-faithful rows: Python-sourced columns come back as
+                # lists/scalars (tolist), numpy-sourced ones stay numpy —
+                # the shm lane must hand user code the SAME kinds of
+                # objects the pickled path would
+                slices = [
+                    c[cursor : cursor + n].tolist()
+                    if (py and not as_numpy)
+                    else c[cursor : cursor + n]
+                    for c, py in zip(cols, py_cols)
+                ]
                 rows = list(slices[0]) if single else list(zip(*slices))
                 for row in rows:
                     _consume(row)
@@ -230,7 +237,7 @@ class DataFeed:
             if cursor >= total:
                 _segment_done()
             else:
-                self._cols = (cols, single, cursor, total)
+                self._cols = (cols, single, py_cols, cursor, total)
             return n
 
         while count < batch_size:
@@ -269,7 +276,7 @@ class DataFeed:
                 # Manager socket; keep it columnar and slice batches out
                 cols = item.materialize()
                 if item.count:
-                    self._cols = (cols, item.single, 0, item.count)
+                    self._cols = (cols, item.single, item.py_cols, 0, item.count)
                     self._chunk_open = True
                 else:
                     queue_in.task_done()

@@ -515,6 +515,28 @@ def _connect_executor_channel():
     return state, TFManager.connect(state["address"], state["authkey"])
 
 
+def drain_queue(mgr, qname, max_items=100000):
+    """Empty a feed queue at teardown, releasing shared-memory segments the
+    consumer never materialized (a dead jax child cannot unlink them; the
+    age-gated janitor is a day-scale backstop, not the primary cleanup)."""
+    from tensorflowonspark_tpu.shm import ShmChunk
+
+    q = mgr.get_queue(qname)
+    drained = 0
+    for _ in range(max_items):
+        try:
+            item = q.get_nowait()
+        except Exception:
+            break
+        if isinstance(item, ShmChunk):
+            item.discard()
+        q.task_done()
+        drained += 1
+    if drained:
+        logger.info("drained %d unconsumed item(s) from %r at shutdown", drained, qname)
+    return drained
+
+
 def peek_error(mgr):
     """Non-destructively read a traceback from a node's error queue, or None.
 

@@ -119,8 +119,27 @@ class _Predictor:
         self._thread.start()
 
     def submit(self, arrays):
-        """Blocking predict; thread-safe. Returns the outputs dict."""
+        """Blocking predict; thread-safe. Returns the outputs dict.
+
+        Rejects malformed requests HERE (0-d arrays, mismatched leading
+        dims, empty input dict) so a bad request becomes the caller's error
+        reply, never a predictor-thread crash."""
+        import numpy as np
         from concurrent.futures import Future
+
+        if not arrays:
+            raise ValueError("predict requires at least one input column")
+        lead = set()
+        for name, arr in arrays.items():
+            arr = np.asarray(arr)
+            if arr.ndim == 0:
+                raise ValueError(
+                    "input {!r} is a scalar; batch inputs need a leading "
+                    "(row) dimension".format(name)
+                )
+            lead.add(arr.shape[0])
+        if len(lead) != 1:
+            raise ValueError("input columns disagree on row count: {}".format(sorted(lead)))
 
         fut = Future()
         # the lock orders every put against stop()'s sentinel: a submit that
@@ -136,9 +155,16 @@ class _Predictor:
         with self._submit_lock:
             self._stopped = True
             self._q.put(self._stop)
-        self._thread.join(timeout=10)
-        # fail any request that was still queued so no caller blocks forever
-        # on a future that will never resolve
+        self._thread.join(timeout=60)
+        if self._thread.is_alive():
+            # an in-flight predict (e.g. a first-call XLA compile) outlived
+            # the join: the thread still owns the queue/backlog and will
+            # serve everything up to the sentinel, then exit. Draining here
+            # would steal the sentinel and race its Future operations.
+            logger.warning("predictor still busy at stop(); it will drain and exit")
+            return
+        # thread exited: fail anything still queued so no caller blocks
+        # forever on a future that will never resolve
         leftovers = list(self._backlog)
         self._backlog.clear()
         while True:
@@ -171,27 +197,45 @@ class _Predictor:
                 self._backlog.clear()
                 return
             batch = [item]
-            sig = self._signature(item[0])
-            rows = next(iter(item[0].values())).shape[0] if item[0] else 0
-            # coalesce same-signature requests already waiting; non-matching
-            # ones go to the backlog, which is served FIRST next cycle (FIFO
-            # within one deferral — a minority-signature request waits at
-            # most one predict cycle)
-            scanned = []
-            while rows < self._max_rows and not self._backlog:
+            try:
+                sig = self._signature(item[0])
+                rows = next(iter(item[0].values())).shape[0]
+            except Exception as e:  # malformed request that slipped validation
+                item[1].set_exception(e)
+                continue
+            # coalesce same-signature requests: deferred (older) ones first,
+            # then whatever is already waiting on the queue. Non-matching
+            # requests keep FIFO order in the backlog, whose head seeds the
+            # next cycle — mixed-signature load batches per signature instead
+            # of degrading to one request per dispatch.
+            deferred = []
+            saw_stop = False
+            while self._backlog and rows < self._max_rows:
+                nxt = self._backlog.popleft()
+                if nxt is self._stop:
+                    deferred.append(nxt)
+                    saw_stop = True
+                    break
+                if self._signature(nxt[0]) == sig:
+                    batch.append(nxt)
+                    rows += next(iter(nxt[0].values())).shape[0]
+                else:
+                    deferred.append(nxt)
+            while not saw_stop and rows < self._max_rows:
                 try:
                     nxt = self._q.get_nowait()
                 except queue.Empty:
                     break
                 if nxt is self._stop:
-                    scanned.append(nxt)
+                    deferred.append(nxt)
                     break
                 if self._signature(nxt[0]) == sig and nxt[0]:
                     batch.append(nxt)
                     rows += next(iter(nxt[0].values())).shape[0]
                 else:
-                    scanned.append(nxt)
-            self._backlog.extend(scanned)
+                    deferred.append(nxt)
+            # deferred items are older than anything left in the backlog
+            self._backlog.extendleft(reversed(deferred))
 
             try:
                 if len(batch) == 1:

@@ -49,16 +49,20 @@ class ShmChunk(Marker):
 
     Wire-side it is a tiny picklable object: segment ``name``, row ``count``,
     and per-column ``(dtype, shape, offset)``. ``single`` distinguishes bare
-    rows (one column) from tuple rows (one column per field).
-    """
+    rows (one column) from tuple rows (one column per field). ``py_cols``
+    records, per column, whether the source values were Python objects
+    (lists/ints/floats) rather than numpy — consumers use it to hand back
+    the SAME types the feeder saw (a numpy-array row must come back numpy,
+    a list row as a list)."""
 
-    __slots__ = ("name", "count", "columns", "single")
+    __slots__ = ("name", "count", "columns", "single", "py_cols")
 
-    def __init__(self, name, count, columns, single):
+    def __init__(self, name, count, columns, single, py_cols=None):
         self.name = name
         self.count = count
         self.columns = columns
         self.single = single
+        self.py_cols = tuple(py_cols) if py_cols is not None else (True,) * len(columns)
 
     def __len__(self):
         return self.count
@@ -99,14 +103,20 @@ class ShmChunk(Marker):
             )
         )
         single = not multi
+
+        def _is_py(value):
+            return not isinstance(value, (np.ndarray, np.generic))
+
         try:
             if single:
                 cols = [np.asarray(rows)]
+                py_cols = [_is_py(first)]
             else:
                 width = len(first)
                 if any(len(r) != width for r in rows):
                     return None
                 cols = [np.asarray([r[i] for r in rows]) for i in range(width)]
+                py_cols = [_is_py(first[i]) for i in range(width)]
         except (ValueError, TypeError):
             return None
         for c in cols:
@@ -132,7 +142,7 @@ class ShmChunk(Marker):
             offset += int(c.nbytes)
         seg.close()
         _unregister_from_tracker(name)
-        return ShmChunk(name, len(rows), columns, single)
+        return ShmChunk(name, len(rows), columns, single, py_cols)
 
     # -- consumer --------------------------------------------------------------
 
@@ -173,12 +183,16 @@ class ShmChunk(Marker):
         return list(zip(*cols))
 
     def py_rows(self):
-        """Materialize as PYTHON-typed rows (lists/ints/floats via
-        ``tolist``): the type-faithful path for consumers that expect the
-        exact objects the feeder saw (user ``main_fun`` code iterating rows
-        without ``as_numpy``). Numeric fidelity is exact — the lane only
-        carries uniform numeric rows in the first place."""
-        cols = [c.tolist() for c in self.materialize()]
+        """Materialize as TYPE-FAITHFUL rows: each field comes back as the
+        kind of object the feeder saw — ``tolist`` for Python-sourced
+        columns (lists/ints/floats, exact numeric round trip), numpy arrays
+        kept numpy. The path for consumers iterating rows without
+        ``as_numpy``."""
+        raw = self.materialize()
+        cols = [
+            c.tolist() if py else list(c)
+            for c, py in zip(raw, self.py_cols)
+        ]
         if self.single:
             return cols[0]
         return list(zip(*cols))
@@ -198,10 +212,12 @@ class ShmChunk(Marker):
             logger.warning("failed to discard shm chunk %s", self.name, exc_info=True)
 
 
-def unlink_leaked(max_age_secs=0):
+def unlink_leaked(max_age_secs=86400):
     """Best-effort cleanup of ``tosfeed_*`` segments left by crashed
     consumers (called from executor shutdown). Only touches segments older
-    than ``max_age_secs`` to avoid racing in-flight chunks."""
+    than ``max_age_secs`` to avoid racing in-flight chunks — the default is
+    deliberately a full day (in-flight backlogs are bounded by feed
+    timeouts, default 600 s); pass 0 only in tests that own every segment."""
     import os
     import time
 
