@@ -7,9 +7,19 @@
 # With pyspark installed: additionally boots a local-cluster master so the
 # integration tests can target real Spark executors.
 #
-# Usage: ./run_tests.sh [extra pytest args]
+# Usage: ./run_tests.sh [--quick] [extra pytest args]
+#   --quick  run the quick tier only (pytest -m 'not slow')
 set -euo pipefail
 cd "$(dirname "$0")"
+
+EXTRA=()
+for arg in "$@"; do
+  if [[ "$arg" == "--quick" ]]; then
+    EXTRA+=(-m "not slow")
+  else
+    EXTRA+=("$arg")
+  fi
+done
 
 export JAX_PLATFORMS=cpu
 if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
@@ -24,4 +34,4 @@ else
   echo "pyspark not installed: using the bundled local multi-process backend"
 fi
 
-exec python -m pytest tests/ -q "$@"
+exec python -m pytest tests/ -q ${EXTRA[@]+"${EXTRA[@]}"}

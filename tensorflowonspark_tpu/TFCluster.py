@@ -34,6 +34,38 @@ class InputMode:
     SPARK = 1  #: Spark partitions stream through the executor feed queues
 
 
+def _worker_rows(cluster_info):
+    """Training-participant rows with a reachable channel; the single
+    definition of "which nodes count as workers" shared by shutdown,
+    completion-wait, and abort (ps/evaluator are driver-managed separately)."""
+    return [
+        r for r in cluster_info or []
+        if r["job_name"] in ("chief", "master", "worker") and r.get("manager_addr")
+    ]
+
+
+def _abort_nodes(cluster_info, authkey, reason):
+    """Best-effort abort broadcast to every reachable node channel: posts the
+    ``"abort"`` reason (the executor-side watcher kills the jax child) and
+    releases parked ps/evaluator control loops. Returns
+    {executor_id: (row, mgr)} for the nodes that acknowledged the post."""
+    reached = {}
+    for row in cluster_info or []:
+        if not row.get("manager_addr"):
+            continue
+        try:
+            mgr = TFManager.connect(tuple(row["manager_addr"]), authkey)
+            mgr.set("abort", str(reason))
+            if row["job_name"] in ("ps", "evaluator"):
+                mgr.get_queue("control").put(None, block=False)
+            reached[row["executor_id"]] = (row, mgr)
+        except Exception as e:
+            logger.warning(
+                "abort: could not reach %s:%s: %s", row["job_name"], row["task_index"], e
+            )
+    return reached
+
+
 class TFCluster:
     """Handle to a running cluster; constructed by :func:`run`."""
 
@@ -131,6 +163,21 @@ class TFCluster:
                         self.tf_status.setdefault("error", problem)
 
         threading.Thread(target=_monitor, name="tos-watchdog", daemon=True).start()
+
+    def _current_rows(self):
+        """Freshest node rows. Real Spark retries a failed launch task, and
+        the retry re-registers with a NEW channel address (idempotent REG
+        replaces the row server-side, reservation.Reservations.add) — so for
+        teardown/abort purposes the reservation server's live view supersedes
+        the assembly-time ``cluster_info`` snapshot; otherwise an abort posted
+        to a crashed node's OLD channel would miss the retry's fresh child."""
+        try:
+            rows = self.server.reservations.get()
+            if rows:
+                return rows
+        except Exception:
+            pass
+        return self.cluster_info
 
     def check_errors(self):
         """Raise if the watchdog (or the launch path) recorded a node
@@ -305,10 +352,7 @@ class TFCluster:
         """
         import time
 
-        workers = [
-            r for r in self.cluster_info
-            if r["job_name"] in ("chief", "master", "worker") and r.get("manager_addr")
-        ]
+        workers = _worker_rows(self.cluster_info)
         channels = []
         unreachable = []
         for row in workers:
@@ -374,6 +418,90 @@ class TFCluster:
             TFSparkNode.shutdown(self.cluster_info, self.cluster_meta, grace_secs=grace_secs)
         )
 
+    def abort(self, reason="aborted by driver", wait_secs=60):
+        """Forcibly tear the cluster down so the same SparkContext can
+        relaunch: post an abort reason on every node channel (the
+        executor-side abort watcher kills the jax child, freeing the executor
+        slot), release parked ps/evaluator tasks, then wait for the nodes to
+        report stopped.
+
+        Unlike :meth:`shutdown` this never raises on node errors — it is the
+        teardown half of :func:`run_with_recovery`, called when a failure has
+        already been detected. The reference stopped at detection (SystemExit
+        on the feed path, reference TFCluster.py:178-183); deterministic
+        reclaim + relaunch is the TPU-native recovery story.
+        """
+        import time as _time
+
+        self.tf_status.setdefault("error", str(reason))
+        reached = _abort_nodes(self._current_rows(), self.cluster_meta["authkey"], reason)
+        deadline = _time.time() + wait_secs
+        pending = dict(reached)
+        while pending and _time.time() < deadline:
+            for eid in list(pending):
+                row, mgr = pending[eid]
+                try:
+                    if mgr.get("state") == "stopped":
+                        pending.pop(eid)
+                except Exception:
+                    pending.pop(eid)  # channel gone: the node is down
+            if pending:
+                _time.sleep(0.5)
+        for eid, (row, _) in pending.items():
+            logger.warning(
+                "abort: node %s:%s did not confirm stop within %ss",
+                row["job_name"], row["task_index"], wait_secs,
+            )
+        self.launch_thread.join(timeout=wait_secs)
+        self.server.stop()
+        if self._monitor_stop is not None:
+            self._monitor_stop.set()
+        logger.info("cluster aborted: %s", reason)
+
+    def wait_for_completion(self, poll_secs=1.0, timeout=None):
+        """Block until every worker node retires (channel state ``"stopped"``)
+        or a failure is recorded in ``tf_status`` (InputMode.TENSORFLOW).
+        Returns True on completion/failure, False on timeout.
+
+        Waiting on the *launch thread* instead would hang any cluster with
+        ps/evaluator roles: those tasks park on their control queues until
+        :meth:`shutdown` posts the release, so the launch job outlives
+        training by design (reference ps wait loop, TFSparkNode.py:373-390).
+        Worker channel state is the true completion signal; launch-thread
+        exit also ends the wait. On a NAT'd cluster whose worker channels
+        the driver cannot reach AND with a parked ps/evaluator role, neither
+        signal can fire — pass ``timeout`` to bound the wait there.
+        """
+        import time as _time
+
+        deadline = _time.monotonic() + timeout if timeout is not None else None
+        mgrs = {}  # keyed by channel address: a task retry re-registers anew
+        while not self.tf_status.get("error"):
+            if not self.launch_thread.is_alive():
+                return True
+            done = True
+            # rows re-read each cycle: a Spark task retry may have replaced a
+            # node's channel address server-side mid-wait
+            for row in _worker_rows(self._current_rows()):
+                addr = tuple(row["manager_addr"])
+                try:
+                    mgr = mgrs.get(addr)
+                    if mgr is None:
+                        mgr = mgrs[addr] = TFManager.connect(
+                            addr, self.cluster_meta["authkey"]
+                        )
+                    if mgr.get("state") != "stopped":
+                        done = False
+                except Exception:
+                    mgrs.pop(addr, None)
+                    done = False  # unreachable: rely on launch-thread exit
+            if done:
+                return True
+            if deadline is not None and _time.monotonic() > deadline:
+                return False
+            _time.sleep(poll_secs)
+        return True
+
     # -- observability --------------------------------------------------------
 
     def tensorboard_url(self):
@@ -383,6 +511,85 @@ class TFCluster:
             if row.get("tb_port"):
                 return "http://{}:{}".format(row["host"], row["tb_port"])
         return None
+
+
+def run_with_recovery(
+    sc,
+    map_fun,
+    tf_args,
+    num_executors,
+    max_relaunches=2,
+    poll_secs=1.0,
+    shutdown_timeout=600,
+    completion_timeout=None,
+    **run_kwargs,
+):
+    """Train with automatic failure recovery: run → detect (watchdog / launch
+    error) → :meth:`TFCluster.abort` the survivors → relaunch → ``map_fun``
+    resumes from its latest checkpoint.
+
+    The reference stopped at *detection* — on a node error the feed path
+    raised and the docs told the operator to resubmit the job (reference
+    TFCluster.py:178-183); the hard half (resuming the trajectory from the
+    latest checkpoint) was delegated to TF's ``load_weights_on_restart``.
+    Here the whole loop is driver-side: ``map_fun`` must pick up from
+    ``checkpoint.latest_checkpoint(model_dir)`` when one exists — the
+    contract proven end-to-end in ``tests/test_resume.py`` — and this helper
+    supplies detection, deterministic teardown, and relaunch around it.
+
+    ``InputMode.TENSORFLOW`` only (the perf path: nodes read their own data).
+    In SPARK mode the driver is mid-``train()`` when a node dies and the feed
+    RDD's lineage/position belongs to the caller — recovery there means
+    re-running the caller's feed loop, which only the caller can do.
+
+    ``completion_timeout`` bounds each attempt's completion wait for the one
+    topology where no completion signal can reach the driver (NAT'd worker
+    channels + a parked ps/evaluator keeping the launch job alive — see
+    :meth:`TFCluster.wait_for_completion`); on expiry the attempt proceeds
+    straight to :meth:`TFCluster.shutdown`, whose Spark-task fallback can
+    reach NAT'd nodes. Leave ``None`` for reachable clusters — a legitimate
+    training run can take arbitrarily long.
+
+    Returns the number of relaunches performed (0 = clean first run).
+    """
+    mode = run_kwargs.get("input_mode", InputMode.SPARK)
+    if mode != InputMode.TENSORFLOW:
+        raise ValueError(
+            "run_with_recovery requires input_mode=InputMode.TENSORFLOW; in SPARK "
+            "mode re-feed from the caller's loop after cluster.check_errors() raises"
+        )
+    attempt = 0
+    while True:
+        failure = None
+        cluster = None
+        try:
+            cluster = run(sc, map_fun, tf_args, num_executors, **run_kwargs)
+        except Exception as e:
+            failure = e
+        if cluster is not None:
+            # wait for training to finish, cutting out early on a detected
+            # node failure (watchdog error-queue peek / heartbeat loss);
+            # NOT a launch-thread join — ps/evaluator tasks park until
+            # shutdown, so the launch job outlives training by design
+            cluster.wait_for_completion(poll_secs, timeout=completion_timeout)
+            try:
+                cluster.shutdown(timeout=shutdown_timeout)
+                return attempt
+            except Exception as e:
+                failure = e
+        attempt += 1
+        # tear the failed attempt down BEFORE deciding whether to relaunch:
+        # on the final failure the caller still gets their executors back
+        if cluster is not None:
+            cluster.abort("attempt {} failed: {}".format(attempt, failure))
+        if attempt > max_relaunches:
+            raise RuntimeError(
+                "training failed after {} relaunch(es): {}".format(attempt - 1, failure)
+            ) from failure
+        logger.warning(
+            "cluster attempt %d failed (%s); survivors aborted, relaunching",
+            attempt, failure,
+        )
 
 
 def build_cluster_template(num_executors, num_ps=0, master_node="chief", eval_node=False):
@@ -508,7 +715,9 @@ def run(
             node_rdd.foreachPartition(launch_task)
         except Exception as e:
             logger.error("node launch failed: %s", e)
-            tf_status["error"] = str(e)
+            # first error wins (the watchdog may already have recorded the
+            # root cause; an abort() records its reason the same way)
+            tf_status.setdefault("error", str(e))
 
     launch_thread = threading.Thread(target=_start, name="tos-cluster-launch", daemon=True)
     launch_thread.start()
@@ -524,7 +733,17 @@ def run(
                     sorted(eids), sorted(template.keys())
                 )
             )
-    except BaseException:
+    except BaseException as e:
+        # nodes that DID register have already spawned jax children pinning
+        # their executor slots — abort them, or a retry of run() on the same
+        # SparkContext would starve against our own leak
+        try:
+            _abort_nodes(
+                server.reservations.get(), cluster_meta["authkey"],
+                "cluster assembly failed: {}".format(e),
+            )
+        except Exception:
+            pass
         server.stop()  # don't leak the listener thread/socket on failed assembly
         raise
     for row in sorted(cluster_info, key=lambda r: r["executor_id"]):

@@ -411,6 +411,7 @@ class _NodeLaunchTask:
         )
         child.start()
         self._register_child(child)
+        self._start_abort_watch(mgr, child, job_name, task_index)
 
         if job_name in ("ps", "evaluator"):
             # park until the driver posts a shutdown message on the control
@@ -432,6 +433,15 @@ class _NodeLaunchTask:
             # finishes (reference fg-thread dispatch, TFSparkNode.py:391-395)
             child.join()
             mgr.set("state", "stopped")
+            if child.exitcode != 0 and mgr.get("abort") is not None:
+                # the driver's abort watcher killed this child on purpose:
+                # returning (not raising) keeps Spark from retrying the task
+                # against a cluster that is being torn down
+                logger.info(
+                    "node %s:%d terminated by driver abort: %s",
+                    job_name, task_index, mgr.get("abort"),
+                )
+                return []
             if child.exitcode != 0:
                 err = None
                 try:
@@ -447,6 +457,55 @@ class _NodeLaunchTask:
                     )
                 )
         return []
+
+    @staticmethod
+    def _start_abort_watch(mgr, child, job_name, task_index):
+        """Executor-side kill switch: a daemon thread that terminates the jax
+        child when the driver posts an ``"abort"`` reason on this node's
+        channel (:meth:`TFCluster.TFCluster.abort`).
+
+        This is what makes failure *recovery* possible on top of failure
+        *detection*: in InputMode.TENSORFLOW the launch task blocks in
+        ``child.join()`` holding its executor slot, so after one node dies the
+        surviving nodes' tasks would pin their executors until training ended
+        naturally — and a relaunch on the same SparkContext would queue behind
+        them forever. The reference stopped at detection and SystemExit
+        (reference TFCluster.py:178-183); here the driver can reclaim every
+        executor deterministically and relaunch (``run_with_recovery``).
+
+        The abort flag is a dedicated kv key, NOT a ``state`` value: the
+        state machine's ``"terminating"`` is written by the child to stop the
+        feed plane, and an abort arriving mid-terminate must not race it.
+        The watcher answers every abort — even for a child that already
+        exited on its own (spark-mode tasks return immediately, so nobody
+        else would confirm that node down) — and retires only when the node
+        reaches ``"stopped"`` or its channel dies."""
+        import threading
+
+        def _watch():
+            while True:
+                try:
+                    if mgr.get("abort") is not None:
+                        if child.is_alive():
+                            logger.warning(
+                                "driver abort: terminating jax child %s:%d", job_name, task_index
+                            )
+                            child.terminate()
+                            child.join(timeout=10)
+                            if child.is_alive() and hasattr(child, "kill"):
+                                child.kill()
+                                child.join(timeout=5)
+                        mgr.set("state", "stopped")
+                        return
+                    if mgr.get("state") == "stopped":
+                        return  # node retired through a normal shutdown path
+                except Exception:
+                    return  # channel gone: node already shut down
+                time.sleep(1.0)
+
+        threading.Thread(
+            target=_watch, name="tos-abort-watch-{}-{}".format(job_name, task_index), daemon=True
+        ).start()
 
     @staticmethod
     def _register_child(proc):

@@ -1,0 +1,148 @@
+"""Failure → relaunch → auto-resume, end to end (VERDICT r3 item 8).
+
+The reference stopped at failure *detection* (node error → SystemExit on the
+feed path, reference TFCluster.py:178-183) and told operators to resubmit.
+Here :func:`TFCluster.run_with_recovery` closes the loop driver-side:
+watchdog/launch-error detection → :meth:`TFCluster.abort` (executor-side
+abort watchers kill surviving jax children, freeing the pinned executor
+slots) → relaunch → ``map_fun`` resumes from its latest checkpoint (the
+``tests/test_resume.py`` contract)."""
+
+import json
+import os
+
+import pytest
+
+from tensorflowonspark_tpu import TFCluster
+from tensorflowonspark_tpu.TFCluster import InputMode
+from tensorflowonspark_tpu.backends.local import LocalSparkContext
+
+CPU_ENV = {"JAX_PLATFORMS": "cpu"}
+
+
+def fn_train_resume_or_die(args, ctx):
+    """Trains to ``target_steps`` total, checkpointing every
+    ``checkpoint_steps``; the victim executor SIGKILLs itself at
+    ``kill_at`` — once (a marker file makes the second life survive)."""
+    import signal
+
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import parallel
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.train import SyncDataParallel, checkpoint
+
+    model_dir = os.path.join(args["model_dir"], "worker_{}".format(ctx.executor_id))
+    os.makedirs(model_dir, exist_ok=True)
+    strategy = SyncDataParallel(parallel.local_mesh({"dp": -1}))
+    model = mnist.create_model("mlp", hidden=16)
+    optimizer = optax.sgd(0.1)
+    state = strategy.create_state(
+        mnist.make_init_fn(model), optimizer, jax.random.PRNGKey(0)
+    )
+    latest = checkpoint.latest_checkpoint(model_dir)
+    if latest:
+        state = checkpoint.restore_checkpoint(latest, target=jax.device_get(state))
+    global_step = int(jax.device_get(state.step))
+
+    step = strategy.compile_train_step(
+        mnist.make_loss_fn(model), optimizer, has_aux=True, donate=False
+    )
+    rng = np.random.default_rng(7)
+    batch = strategy.shard_batch(
+        {
+            "image": rng.standard_normal((32, 28, 28)).astype(np.float32),
+            "label": rng.integers(0, 10, 32),
+        }
+    )
+    marker = os.path.join(args["model_dir"], "killed.marker")
+    while global_step < args["target_steps"]:
+        state, metrics = step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        global_step += 1
+        if global_step % args["checkpoint_steps"] == 0:
+            checkpoint.save_checkpoint(
+                os.path.join(model_dir, "ckpt_{}".format(global_step)),
+                jax.device_get(state),
+            )
+        if (
+            ctx.executor_id == args["victim"]
+            and global_step == args["kill_at"]
+            and not os.path.exists(marker)
+        ):
+            with open(marker, "w") as f:
+                f.write("first life died here")
+            os.kill(os.getpid(), signal.SIGKILL)  # no traceback, no cleanup
+    with open(os.path.join(model_dir, "done.json"), "w") as f:
+        json.dump({"final_step": global_step}, f)
+
+
+@pytest.mark.slow
+def test_sigkilled_child_training_finishes_anyway(tmp_path, monkeypatch):
+    monkeypatch.setenv("TOS_MONITOR_INTERVAL", "1")
+    model_dir = str(tmp_path)
+    args = {
+        "model_dir": model_dir,
+        "target_steps": 8,
+        "checkpoint_steps": 2,
+        "kill_at": 5,  # after the step-4 checkpoint, before step-6
+        "victim": 1,
+    }
+    sc = LocalSparkContext(num_executors=2, task_timeout=600)
+    try:
+        relaunches = TFCluster.run_with_recovery(
+            sc, fn_train_resume_or_die, args, num_executors=2,
+            input_mode=InputMode.TENSORFLOW, master_node=None,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
+            max_relaunches=2, shutdown_timeout=240,
+        )
+    finally:
+        sc.stop()
+    assert relaunches == 1, "exactly one relaunch should recover this run"
+    # the victim really died mid-train ...
+    assert os.path.exists(os.path.join(model_dir, "killed.marker"))
+    # ... yet BOTH workers finished the full training
+    for eid in (0, 1):
+        with open(os.path.join(model_dir, "worker_{}".format(eid), "done.json")) as f:
+            assert json.load(f)["final_step"] == args["target_steps"]
+    # the victim resumed from its step-4 checkpoint (not from scratch): its
+    # second life added the 6 and 8 checkpoints on top of 2 and 4
+    victim_ckpts = sorted(
+        d for d in os.listdir(os.path.join(model_dir, "worker_1")) if d.startswith("ckpt_")
+    )
+    assert victim_ckpts == ["ckpt_2", "ckpt_4", "ckpt_6", "ckpt_8"]
+
+
+def fn_touch_and_exit(args, ctx):
+    with open(os.path.join(args["dir"], "ran_{}".format(ctx.executor_id)), "w") as f:
+        f.write(ctx.job_name)
+
+
+def test_run_with_recovery_completes_with_parked_ps_role(tmp_path):
+    """A ps task parks on its control queue until shutdown, so the launch job
+    outlives training by design — completion must key off worker channel
+    state, not launch-thread death (this hung before wait_for_completion)."""
+    d = str(tmp_path)
+    sc = LocalSparkContext(num_executors=2, task_timeout=300)
+    try:
+        relaunches = TFCluster.run_with_recovery(
+            sc, fn_touch_and_exit, {"dir": d}, num_executors=2, num_ps=1,
+            input_mode=InputMode.TENSORFLOW, master_node=None,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
+            max_relaunches=0, shutdown_timeout=120,
+        )
+    finally:
+        sc.stop()
+    assert relaunches == 0
+    # the worker ran; the ps parked and was released at shutdown
+    assert sorted(f for f in os.listdir(d) if f.startswith("ran_")) == ["ran_0", "ran_1"]
+
+
+def test_run_with_recovery_rejects_spark_mode():
+    with pytest.raises(ValueError, match="InputMode.TENSORFLOW"):
+        TFCluster.run_with_recovery(
+            None, lambda a, c: None, {}, num_executors=1,
+            input_mode=InputMode.SPARK,
+        )
