@@ -27,7 +27,7 @@ def stack_stage_params(params_list):
     return jax.tree.map(lambda *leaves: jnp.stack(leaves), *params_list)
 
 
-def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis="pp"):
+def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis="pp", batch_axis=None):
     """Run ``stage_fn`` as a P-stage pipeline over the mesh's ``axis``.
 
     ``stage_fn(stage_params, x) -> y`` is ONE stage's computation; every
@@ -36,6 +36,12 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis="pp"):
     (:func:`stack_stage_params`); ``microbatches`` is ``[M, ...]`` (split a
     global batch with :func:`split_microbatches`). Returns ``[M, ...]``
     outputs, replicated over ``axis``.
+
+    ``batch_axis`` composes the pipeline with data parallelism on one mesh:
+    the within-microbatch dim (dim 1) is sharded over that axis, so a
+    ``{"pp": P, "dp": D}`` mesh runs D activation shards through P stages
+    concurrently — each dp column owns its slice end to end, the ppermute
+    stage hops stay within the column, and params are replicated over dp.
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -72,11 +78,12 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis="pp"):
         # replicated over the pp axis (cheap at microbatch scale)
         return lax.psum(jnp.where(idx == n_pp - 1, out, jnp.zeros_like(out)), axis)
 
+    data_spec = P(None, batch_axis) if batch_axis else P()
     return shard_map(
         _worker,
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
+        in_specs=(P(axis), data_spec),
+        out_specs=data_spec,
         check_vma=False,
     )(stacked_params, microbatches)
 
