@@ -16,6 +16,9 @@ Modes (BENCH_MODE env):
   seq ``BENCH_SEQ`` default 4096, bf16): the beyond-parity flagship.
 * ``feed_plane`` — pure feed-plane rows/sec (shm lane vs pickled chunks),
   ResNet- and MNIST-shaped rows, no Spark shipping or training.
+* ``serving`` — live InferenceServer rows/sec + p50/p99 request latency,
+  N concurrent clients, coalescing ON vs OFF (``vs_baseline`` = the
+  coalescing speedup over one-dispatch-per-request).
 * ``mnist_epoch`` — BASELINE.json metric 2, "MNIST epoch time
   (InputMode.SPARK)": wall-clock seconds to push one epoch of MNIST-shaped
   rows through a live 1-worker cluster's feed plane (reservation server,
@@ -473,6 +476,126 @@ def bench_feed_plane():
     }
 
 
+def bench_serving(tiny):
+    """``BENCH_MODE=serving`` — live InferenceServer (binary tensor lane):
+    throughput + request latency under N concurrent clients, coalescing ON
+    vs OFF (``TOS_SERVING_COALESCE_ROWS=1`` makes every request its own
+    dispatch). Rounds interleave ON/OFF within one process and compare
+    medians — the only honest A/B on a link whose latency swings 3x within
+    minutes (docs/perf.md "Measurement honesty"). ``vs_baseline`` is the
+    coalescing speedup (the round-2 design — one global lock, one dispatch
+    per request — is the OFF leg's lower bound). Reference shape: the JVM
+    batch-inference path, TFModel.scala:245-288."""
+    import statistics
+    import sys
+    import tempfile
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from tensorflowonspark_tpu.serving import InferenceClient, InferenceServer
+    from tensorflowonspark_tpu.train import export
+
+    n_clients = int(os.environ.get("BENCH_SERVING_CLIENTS", "8"))
+    reqs_per_client = int(os.environ.get("BENCH_SERVING_REQS", "2" if tiny else "12"))
+    batch = int(os.environ.get("BENCH_SERVING_BATCH", "16"))
+    rounds = 1 if tiny else 3
+
+    def predict_builder():
+        import jax as _jax
+
+        from tensorflowonspark_tpu.models import mnist as _mnist
+
+        _model = _mnist.create_model("cnn")
+        _predict = _mnist.make_predict_fn(_model)
+        return _jax.jit(lambda p, ms, a: {"prediction": _predict(p, {"image": a["image"]})})
+
+    import jax
+
+    from tensorflowonspark_tpu.models import mnist
+
+    model = mnist.create_model("cnn")
+    params = jax.device_get(mnist.make_init_fn(model)(jax.random.PRNGKey(0))["params"])
+    bundle = tempfile.mkdtemp(prefix="tos_bench_serving_")
+    export.export_model(bundle, predict_builder, params)
+
+    rng = np.random.default_rng(0)
+    image = rng.standard_normal((batch, 28, 28)).astype(np.float32)
+
+    def run_leg(coalesce):
+        prior = os.environ.get("TOS_SERVING_COALESCE_ROWS")
+        os.environ["TOS_SERVING_COALESCE_ROWS"] = "1024" if coalesce else "1"
+        try:
+            srv = InferenceServer(bundle)
+        finally:  # the predictor captured the knob at init; don't leak it
+            if prior is None:
+                os.environ.pop("TOS_SERVING_COALESCE_ROWS", None)
+            else:
+                os.environ["TOS_SERVING_COALESCE_ROWS"] = prior
+        srv.start()
+        try:
+            clients = [InferenceClient(srv.address) for _ in range(n_clients)]
+            clients[0].predict_binary(image=image)  # jit warm-up outside timing
+            lat = []
+            lat_lock = threading.Lock()
+
+            def worker(c):
+                mine = []
+                for _ in range(reqs_per_client):
+                    t0 = _time.perf_counter()
+                    out = c.predict_binary(image=image)
+                    mine.append(_time.perf_counter() - t0)
+                    assert out["prediction"].shape == (batch,)
+                with lat_lock:
+                    lat.extend(mine)
+
+            threads = [threading.Thread(target=worker, args=(c,)) for c in clients]
+            t0 = _time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = _time.perf_counter() - t0
+            for c in clients:
+                c.close()
+            total_rows = n_clients * reqs_per_client * batch
+            lat.sort()
+            return {
+                "rows_per_sec": total_rows / wall,
+                "p50_ms": 1e3 * lat[len(lat) // 2],
+                "p99_ms": 1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+            }
+        finally:
+            srv.stop()
+
+    on, off = [], []
+    for _ in range(rounds):  # interleaved A/B
+        on.append(run_leg(True))
+        off.append(run_leg(False))
+    med = lambda legs, k: statistics.median(leg[k] for leg in legs)  # noqa: E731
+    for name, legs in (("coalesced", on), ("uncoalesced", off)):
+        print(
+            "serving {}: {:.0f} rows/s, p50 {:.0f} ms, p99 {:.0f} ms "
+            "({} clients x {} reqs x {} rows)".format(
+                name, med(legs, "rows_per_sec"), med(legs, "p50_ms"),
+                med(legs, "p99_ms"), n_clients, reqs_per_client, batch,
+            ),
+            file=sys.stderr,
+        )
+    import shutil
+
+    shutil.rmtree(bundle, ignore_errors=True)
+    return {
+        "metric": "serving_rows_per_sec",
+        "value": round(med(on, "rows_per_sec"), 1),
+        "unit": "rows/sec ({} clients, batch {}, mnist-cnn; p50 {:.0f} ms p99 {:.0f} ms)".format(
+            n_clients, batch, med(on, "p50_ms"), med(on, "p99_ms")
+        ),
+        "vs_baseline": round(med(on, "rows_per_sec") / med(off, "rows_per_sec"), 2),
+    }
+
+
 def main():
     tiny = os.environ.get("BENCH_TINY") == "1"
     # headline = the REAL input path (TFRecords -> decode/augment -> uint8
@@ -486,6 +609,8 @@ def main():
         result = bench_feed_plane()
     elif mode == "lm":
         result = bench_lm(tiny)
+    elif mode == "serving":
+        result = bench_serving(tiny)
     else:
         result = bench_resnet(tiny, real_data=(mode != "resnet"))
     print(json.dumps(result))

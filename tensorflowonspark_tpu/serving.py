@@ -26,6 +26,14 @@ reservation control plane):
 * anything else / failure → ``{"type": "error", "message": ...}`` (an error
   reply is NEVER followed by a raw frame).
 
+**Trust boundary**: a model bundle contains pickled CODE
+(``predict_builder.pkl``), executed when the bundle loads — the jax analogue
+of a SavedModel executing its graph, but with Python's full power. Serve
+only bundles you produced or vetted. For bundles from untrusted storage use
+``--trusted_builder MODULE:ATTR``: the builder comes from your own code and
+weights load from ``weights.npz`` with ``allow_pickle=False``, so nothing in
+``--export_dir`` is unpickled (details: train/export.py docstring).
+
 Batch CLI (the reference's ``Inference.scala:52-79`` analogue — TFRecords
 in, predictions out as files, no server involved):
 
@@ -245,6 +253,25 @@ class _Predictor:
                         name: np.concatenate([req[0][name] for req in batch])
                         for name in batch[0][0]
                     }
+                    # pad coalesced batches up to a power-of-two bucket:
+                    # arbitrary concatenated row counts would make every
+                    # distinct total a fresh XLA compile (seconds-long on
+                    # TPU), serializing the very requests coalescing exists
+                    # to speed up. Single requests keep their exact shape —
+                    # the client's batch size is the client's contract.
+                    # Row-wise semantics make the padding rows inert; the
+                    # per-request split below never reads them.
+                    # capped at the operator's row limit: the coalesce loop
+                    # can overshoot _max_rows by one request, and padding
+                    # must not double that into an even bigger dispatch
+                    bucket = min(1 << (rows - 1).bit_length(), self._max_rows)
+                    if bucket > rows:
+                        arrays = {
+                            name: np.concatenate(
+                                [a, np.zeros((bucket - rows,) + a.shape[1:], a.dtype)]
+                            )
+                            for name, a in arrays.items()
+                        }
                 outputs = self._predict_fn(self._params, self._model_state, arrays)
                 if not isinstance(outputs, dict):
                     outputs = {"output": outputs}
@@ -273,11 +300,13 @@ class InferenceServer:
     thread-per-connection; predictions funnel through the coalescing
     :class:`_Predictor`."""
 
-    def __init__(self, export_dir, host="", port=0, max_threads=None):
+    def __init__(self, export_dir, host="", port=0, max_threads=None, trusted_builder=None):
         from tensorflowonspark_tpu.train import export
 
         self.export_dir = export_dir
-        predict_fn, params, model_state = export.load_model(export_dir)
+        predict_fn, params, model_state = export.load_model(
+            export_dir, trusted_builder=trusted_builder
+        )
         self._predictor = _Predictor(predict_fn, params, model_state)
         self._max_threads = max_threads or int(os.environ.get("TOS_SERVING_THREADS", "32"))
         self._pool = None
@@ -500,6 +529,7 @@ def run_batch_inference(
     output_mapping=None,
     out_format="json",
     server=None,
+    trusted_builder=None,
 ):
     """TFRecord shards → bundle predictions → output shards (one output shard
     per input shard; ``json`` = one JSON object per record per line,
@@ -529,7 +559,9 @@ def run_batch_inference(
     else:
         from tensorflowonspark_tpu.train import export
 
-        predict_fn, params, model_state = export.load_model(export_dir)
+        predict_fn, params, model_state = export.load_model(
+            export_dir, trusted_builder=trusted_builder
+        )
         predictor = _Predictor(predict_fn, params, model_state)
         _submit = predictor.submit
         _stop = predictor.stop
@@ -619,6 +651,12 @@ def main(argv=None):
     serve_p.add_argument("--export_dir", required=True)
     serve_p.add_argument("--host", default="")
     serve_p.add_argument("--port", type=int, default=8500)
+    serve_p.add_argument(
+        "--trusted_builder", default=None, metavar="MODULE:ATTR",
+        help="take the predict-fn builder from your own code instead of the "
+             "bundle's pickle; with npz weights, nothing from --export_dir "
+             "is unpickled (safe for untrusted storage). Without this flag "
+             "the bundle is TRUSTED: loading it executes its pickled code.")
 
     infer_p = sub.add_parser("infer", help="batch inference: TFRecords -> prediction shards")
     infer_p.add_argument("--tfrecords", required=True, help="input TFRecord shard dir")
@@ -632,6 +670,8 @@ def main(argv=None):
     infer_p.add_argument("--server", default=None, metavar="HOST:PORT",
                          help="route batches to a running InferenceServer over "
                               "the binary tensor lane instead of loading the bundle")
+    infer_p.add_argument("--trusted_builder", default=None, metavar="MODULE:ATTR",
+                         help="safe-load lane for --export_dir (see serve --help)")
 
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
@@ -652,11 +692,14 @@ def main(argv=None):
             output_mapping=_parse_mapping(args.output_mapping),
             out_format=args.format,
             server=server_addr,
+            trusted_builder=args.trusted_builder,
         )
         print(json.dumps({"inferred": total, "output": args.output}), flush=True)
         return
 
-    server = InferenceServer(args.export_dir, args.host, args.port)
+    server = InferenceServer(
+        args.export_dir, args.host, args.port, trusted_builder=args.trusted_builder
+    )
     host, port = server.start()
     print(json.dumps({"serving": args.export_dir, "host": host or "0.0.0.0", "port": port}), flush=True)
     try:

@@ -14,8 +14,19 @@ but an export bundle is the *serving* artifact, written by the chief alone
 from fully-gathered host arrays (the reference's chief-exports-SavedModel
 dance, compat.py:10-17). Using the collective path here would deadlock a
 chief-only export in a jax.distributed world.
+
+**Trust boundary.** A bundle is a *trusted artifact*: ``predict_builder.pkl``
+is cloudpickled CODE, executed on load — exactly as a TF SavedModel executes
+its graph, but with Python's full power. Only load bundles you produced or
+vetted. For untrusted-storage deployments there is a safe lane: weights are
+written as ``weights.npz`` (plain arrays, loaded with ``allow_pickle=False``)
+whenever the param tree is nested dicts of arrays, and
+``load_model(export_dir, trusted_builder=...)`` takes the predict-fn builder
+from YOUR code (a callable or ``"module:attr"`` string) so nothing from the
+bundle directory is ever unpickled.
 """
 
+import importlib
 import logging
 import os
 
@@ -24,8 +35,14 @@ import cloudpickle
 logger = logging.getLogger(__name__)
 
 _BUILDER_FILE = "predict_builder.pkl"
-_WEIGHTS_FILE = "weights.pkl"
+_WEIGHTS_FILE = "weights.pkl"  # fallback for non-dict-tree states (+ read-compat)
+_WEIGHTS_NPZ = "weights.npz"  # safe lane: plain arrays, no pickle on load
 _CKPT_DIR = "checkpoint"  # legacy orbax-format bundles (read-compat)
+#: npz key separator for flattened tree paths; '/' cannot appear in flax
+#: param-dict keys but guard anyway at write time
+_SEP = "/"
+#: npz key suffix marking an exotic-dtype (ml_dtypes) leaf stored as bytes
+_DTYPE_TAG = "::dtype="
 
 
 def export_model(export_dir, predict_builder, params, model_state=None):
@@ -48,26 +65,175 @@ def export_model(export_dir, predict_builder, params, model_state=None):
         state = jax.tree.map(np.asarray, jax.device_get(state))
     except ImportError:
         pass
-    tmp = os.path.join(export_dir, _WEIGHTS_FILE + ".tmp")
-    with open(tmp, "wb") as f:
-        cloudpickle.dump(state, f)
-    os.replace(tmp, os.path.join(export_dir, _WEIGHTS_FILE))
+    # an empty model_state is omitted from the npz (load_model reconstructs
+    # absent model_state as {}); an empty params tree has no such default and
+    # rides the pickle fallback via _flatten_dict_tree's empty-dict rejection
+    npz_tree = {k: v for k, v in state.items() if k != "model_state" or v}
+    flat = _flatten_dict_tree(npz_tree)
+    if flat is not None:
+        tmp = os.path.join(export_dir, _WEIGHTS_NPZ + ".tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **flat)
+        os.replace(tmp, os.path.join(export_dir, _WEIGHTS_NPZ))
+        _remove_stale(export_dir, _WEIGHTS_FILE)
+    else:
+        logger.warning(
+            "state tree is not nested dicts of arrays; falling back to "
+            "pickled weights (the npz safe-load lane will be unavailable)"
+        )
+        tmp = os.path.join(export_dir, _WEIGHTS_FILE + ".tmp")
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(state, f)
+        os.replace(tmp, os.path.join(export_dir, _WEIGHTS_FILE))
+        _remove_stale(export_dir, _WEIGHTS_NPZ)
+    # a re-export into a legacy orbax-era bundle dir must not leave the old
+    # checkpoint behind either: load_model prefers file lanes, but a later
+    # deletion of the new weights file would silently revive stale params
+    _remove_stale(export_dir, _CKPT_DIR)
     with open(os.path.join(export_dir, _BUILDER_FILE), "wb") as f:
         cloudpickle.dump(predict_builder, f)
     logger.info("exported model bundle to %s", export_dir)
     return export_dir
 
 
-def load_model(export_dir):
-    """Load a bundle: returns ``(predict_fn, params, model_state)``."""
+def _remove_stale(export_dir, name):
+    """Drop the OTHER weight lane's leftover so load_model can never pair
+    this export's builder with a previous export's params."""
+    import shutil
+
+    path = os.path.join(export_dir, name)
+    try:
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+    except OSError as e:
+        logger.warning("could not remove stale %s: %s", path, e)
+
+
+def _flatten_dict_tree(tree):
+    """Nested dicts of array-likes → {path: ndarray}, or None when the tree
+    has non-dict containers / non-string / separator-bearing keys / object
+    leaves (those fall back to the pickle lane)."""
+    import numpy as np
+
+    out = {}
+
+    def _walk(prefix, node):
+        if isinstance(node, dict):
+            if not node:
+                # npz cannot represent an empty subtree; a reload would drop
+                # it and change the structure — pickle lane instead
+                raise ValueError(prefix)
+            for k, v in node.items():
+                if not isinstance(k, str) or _SEP in k or _DTYPE_TAG in k:
+                    raise ValueError(k)
+                _walk(prefix + (k,), v)
+        elif isinstance(node, (list, tuple)):
+            # np.asarray would stack these into one ndarray, silently
+            # changing the tree's structure on reload — pickle lane instead
+            raise ValueError(prefix)
+        else:
+            arr = np.asarray(node)
+            key = _SEP.join(prefix)
+            if arr.dtype.kind in "biufcSUMm":
+                out[key] = arr
+            else:
+                # exotic dtype (ml_dtypes bfloat16/fp8 — the flagship LM
+                # exports bf16): np.savez would store these as raw void and
+                # reload as unusable V2 arrays, so store the bytes with the
+                # dtype name tagged in the key and view them back on load
+                name = arr.dtype.name
+                try:
+                    import ml_dtypes
+
+                    getattr(ml_dtypes, name)
+                except (ImportError, AttributeError):
+                    raise ValueError(prefix)  # unknown dtype: pickle lane
+                raw = np.ascontiguousarray(arr).reshape(arr.shape + (1,)).view(np.uint8)
+                out[key + _DTYPE_TAG + name] = raw
+
+    try:
+        _walk((), tree)
+    except ValueError:
+        return None
+    return out
+
+
+def _unflatten_dict_tree(flat):
+    import numpy as np
+
+    root = {}
+    for path, arr in flat.items():
+        if _DTYPE_TAG in path:
+            path, name = path.rsplit(_DTYPE_TAG, 1)
+            import ml_dtypes
+
+            v = arr.view(getattr(ml_dtypes, name))  # byte view → (..., 1)
+            arr = v.reshape(v.shape[:-1])  # drop the synthetic last axis
+        parts = path.split(_SEP)
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return root
+
+
+def resolve_builder(spec):
+    """``"module:attr"`` (or dotted ``module.attr``) → the builder callable;
+    callables pass through."""
+    if callable(spec):
+        return spec
+    mod, sep, attr = spec.partition(":")
+    if not sep:
+        mod, _, attr = spec.rpartition(".")
+    if not mod or not attr:
+        raise ValueError(
+            "trusted_builder must be callable or 'module:attr', got {!r}".format(spec)
+        )
+    return getattr(importlib.import_module(mod), attr)
+
+
+def load_model(export_dir, trusted_builder=None):
+    """Load a bundle: returns ``(predict_fn, params, model_state)``.
+
+    ``trusted_builder`` (callable or ``"module:attr"``) supplies the
+    predict-fn builder from the CALLER'S code instead of unpickling
+    ``predict_builder.pkl`` — combined with the npz weights lane
+    (``allow_pickle=False``) nothing from ``export_dir`` is ever unpickled,
+    so a tampered bundle can corrupt predictions but cannot execute code.
+    Without it, loading a bundle executes pickled code: treat the bundle as
+    a trusted artifact (see module docstring).
+    """
+    import numpy as np
+
     export_dir = os.path.abspath(os.path.expanduser(export_dir))
-    with open(os.path.join(export_dir, _BUILDER_FILE), "rb") as f:
-        predict_builder = cloudpickle.load(f)
+    if trusted_builder is not None:
+        predict_builder = resolve_builder(trusted_builder)
+    else:
+        with open(os.path.join(export_dir, _BUILDER_FILE), "rb") as f:
+            predict_builder = cloudpickle.load(f)
+    npz = os.path.join(export_dir, _WEIGHTS_NPZ)
     weights = os.path.join(export_dir, _WEIGHTS_FILE)
-    if os.path.isfile(weights):
+    if os.path.isfile(npz):
+        with np.load(npz, allow_pickle=False) as z:
+            state = _unflatten_dict_tree({k: z[k] for k in z.files})
+    elif os.path.isfile(weights):
+        if trusted_builder is not None:
+            raise ValueError(
+                "bundle {} has pickled weights ({}) — the trusted_builder "
+                "safe-load lane requires npz weights (re-export with a "
+                "dict-tree state)".format(export_dir, _WEIGHTS_FILE)
+            )
         with open(weights, "rb") as f:
             state = cloudpickle.load(f)
     else:  # legacy orbax-format bundle
+        if trusted_builder is not None:
+            raise ValueError(
+                "bundle {} has no npz weights (legacy checkpoint format) — "
+                "the trusted_builder safe-load lane deserializes nothing "
+                "from the bundle dir; re-export to get npz weights".format(export_dir)
+            )
         from tensorflowonspark_tpu.train import checkpoint
 
         state = checkpoint.restore_checkpoint(os.path.join(export_dir, _CKPT_DIR))

@@ -370,3 +370,165 @@ def test_batch_inference_cli_tfrecord_output(tmp_path):
     assert len(recs) == 4
     feats = tfrecord.decode_example(recs[2])
     np.testing.assert_allclose(feats["y_"][1], [5.0])
+
+
+# -- trust model: npz safe lane + trusted builder (VERDICT r3 weak 4) --------
+
+
+def _linear_builder():
+    def predict(params, model_state, arrays):
+        return {"y_": arrays["x"] @ params["w"] + params["b"]}
+
+    return predict
+
+
+def test_export_writes_npz_weights_not_pickle(tmp_path):
+    import os
+
+    path = _bundle(tmp_path)
+    assert os.path.isfile(os.path.join(path, "weights.npz"))
+    assert not os.path.isfile(os.path.join(path, "weights.pkl"))
+    # and npz loads with pickle disabled (plain arrays only)
+    with np.load(os.path.join(path, "weights.npz"), allow_pickle=False) as z:
+        assert "params/w" in z.files
+
+
+def test_trusted_builder_loads_without_unpickling_anything(tmp_path):
+    """With trusted_builder + npz weights, a tampered predict_builder.pkl is
+    never even opened — the no-code-execution contract of the safe lane."""
+    import os
+
+    from tensorflowonspark_tpu.train import export as export_mod
+
+    path = _bundle(tmp_path)
+    with open(os.path.join(path, "predict_builder.pkl"), "wb") as f:
+        f.write(b"\x80\x04TAMPERED-NOT-A-PICKLE")
+    predict_fn, params, model_state = export_mod.load_model(
+        path, trusted_builder=_linear_builder
+    )
+    out = predict_fn(params, model_state, {"x": np.ones((1, 2), np.float32)})
+    np.testing.assert_allclose(out["y_"], [[6.0]])
+
+
+def test_trusted_builder_refuses_pickled_weights(tmp_path):
+    """A non-dict-tree state falls back to pickled weights; the safe lane
+    must refuse such a bundle instead of silently unpickling."""
+    import pytest
+
+    from tensorflowonspark_tpu.train import export as export_mod
+
+    path = str(tmp_path / "listy")
+    # list-valued leaf container -> no npz lane
+    export_mod.export_model(
+        path, _linear_builder,
+        {"w": [np.zeros((2, 1), np.float32)], "b": np.zeros(1, np.float32)},
+    )
+    import os
+
+    assert os.path.isfile(os.path.join(path, "weights.pkl"))
+    with pytest.raises(ValueError, match="pickled weights"):
+        export_mod.load_model(path, trusted_builder=_linear_builder)
+    # ...but the default (trusted-artifact) path still loads it
+    predict_fn, params, _ = export_mod.load_model(path)
+    assert isinstance(params["w"], list)
+
+
+def test_resolve_builder_specs():
+    import pytest
+
+    from tensorflowonspark_tpu.train.export import resolve_builder
+
+    assert resolve_builder("os.path:join") is __import__("os.path").path.join
+    assert resolve_builder("os.path.join") is __import__("os.path").path.join
+    assert resolve_builder(_linear_builder) is _linear_builder
+    with pytest.raises(ValueError, match="trusted_builder"):
+        resolve_builder("no-colon-no-dot")
+
+
+def test_server_with_trusted_builder_end_to_end(tmp_path):
+    from tensorflowonspark_tpu.serving import InferenceClient, InferenceServer
+
+    srv = InferenceServer(_bundle(tmp_path), trusted_builder=_linear_builder)
+    srv.start()
+    try:
+        client = InferenceClient(srv.address)
+        out = client.predict(x=[[1.0, 1.0]])
+        np.testing.assert_allclose(out["y_"], [[6.0]])
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_npz_lane_preserves_bfloat16(tmp_path):
+    """The flagship LM exports bf16 params; npz must round-trip ml_dtypes
+    exactly (raw savez would reload them as unusable void arrays)."""
+    import ml_dtypes
+
+    from tensorflowonspark_tpu.train import export as export_mod
+
+    w = np.arange(6, dtype=np.float32).reshape(2, 3).astype(ml_dtypes.bfloat16)
+    s = np.float32(2.5).astype(ml_dtypes.bfloat16)  # 0-d leaf
+    path = str(tmp_path / "bf16")
+    export_mod.export_model(path, _linear_builder, {"w": w, "nested": {"s": s}})
+    import os
+
+    assert os.path.isfile(os.path.join(path, "weights.npz"))
+    _, params, _ = export_mod.load_model(path, trusted_builder=_linear_builder)
+    assert params["w"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(params["w"], w)
+    assert params["nested"]["s"].dtype == ml_dtypes.bfloat16
+    assert params["nested"]["s"].shape == ()
+    assert float(params["nested"]["s"]) == 2.5
+
+
+def test_empty_subtree_falls_back_to_pickle(tmp_path):
+    """npz can't represent an empty dict subtree; such states take the
+    pickle lane so the reloaded structure is identical."""
+    import os
+
+    from tensorflowonspark_tpu.train import export as export_mod
+
+    path = str(tmp_path / "emptysub")
+    export_mod.export_model(
+        path, _linear_builder, {"w": np.zeros((2, 1), np.float32), "extra": {}}
+    )
+    assert os.path.isfile(os.path.join(path, "weights.pkl"))
+    _, params, _ = export_mod.load_model(path)
+    assert params["extra"] == {}
+
+
+def test_reexport_removes_stale_weight_lane(tmp_path):
+    """Re-exporting into the same dir with the other weights lane must not
+    leave the previous lane's file where load_model would prefer it."""
+    import os
+
+    from tensorflowonspark_tpu.train import export as export_mod
+
+    path = str(tmp_path / "reexport")
+    export_mod.export_model(path, _linear_builder, {"w": np.full((2, 1), 7.0, np.float32),
+                                                    "b": np.zeros(1, np.float32)})
+    assert os.path.isfile(os.path.join(path, "weights.npz"))
+    # second export: list leaf -> pickle lane; the npz from export 1 must go
+    export_mod.export_model(path, _linear_builder,
+                            {"w": [np.zeros((2, 1), np.float32)], "b": np.zeros(1, np.float32)})
+    assert os.path.isfile(os.path.join(path, "weights.pkl"))
+    assert not os.path.isfile(os.path.join(path, "weights.npz"))
+    _, params, _ = export_mod.load_model(path)
+    assert isinstance(params["w"], list), "must serve the NEW export's params"
+
+
+def test_trusted_builder_refuses_legacy_checkpoint_bundle(tmp_path):
+    """The safe lane must refuse the legacy orbax fallback too — it parses
+    bundle-dir bytes, which the lane promises never to do."""
+    import os
+
+    import pytest
+
+    from tensorflowonspark_tpu.train import export as export_mod
+
+    path = str(tmp_path / "legacy")
+    os.makedirs(os.path.join(path, "checkpoint"))
+    with open(os.path.join(path, "predict_builder.pkl"), "wb") as f:
+        f.write(b"irrelevant")
+    with pytest.raises(ValueError, match="legacy checkpoint"):
+        export_mod.load_model(path, trusted_builder=_linear_builder)
