@@ -69,47 +69,17 @@ def bench_resnet(tiny, real_data):
 
     n_chips = jax.device_count()
     batch = int(os.environ.get("BENCH_BATCH", 8 if tiny else 128)) * n_chips
-    steps = int(os.environ.get("BENCH_STEPS", 3 if tiny else 20))
+    # real mode defaults to a LONG timed block (8 fused dispatches): the
+    # prefetch pipeline keeps ~1 window in flight across the timing fence,
+    # so short blocks over-credit throughput by up to one window's transfer
+    # — at 8 dispatches the boundary bias is bounded at ~1/8
+    steps = int(os.environ.get("BENCH_STEPS", 3 if tiny else (64 if real_data else 20)))
     image_size = 32 if tiny else 224
     dtype = jnp.float32 if tiny else jnp.bfloat16
     # K train steps fused into one lax.scan dispatch (0/1 = per-step dispatch)
     fused = int(os.environ.get("BENCH_FUSED", 0 if tiny else 8))
     packed = False
-    link_fixed_s = link_bw_mbps = None
-    if real_data and not tiny:
-        # probe the link BEFORE choosing the transfer shape: two sizes solve
-        # T = fixed + size/bw. When the fixed cost rivals a batch's stream
-        # time, shipping the whole K-step window as ONE transfer (packed)
-        # amortizes it K x; when bandwidth dominates, per-batch overlapped
-        # transfers win. This relay swings between both regimes (perf.md),
-        # so the bench adapts per run. BENCH_PACKED=0/1 forces.
-        import jax as _jax
-        import numpy as _np
-
-        def _probe(nbytes, reps=3):
-            # min-of-N: transient relay stalls otherwise corrupt the model
-            arr = _np.zeros((nbytes,), _np.uint8)
-            _jax.block_until_ready(_jax.device_put(arr))
-            times = []
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                a = _jax.device_put(arr)
-                _np.asarray(a[0])
-                times.append(time.perf_counter() - t0)
-            return min(times)
-
-        t_small, t_big = _probe(1 << 20), _probe(16 << 20)
-        # bw capped at 1 GB/s: below the cap the 15 MB size delta is
-        # measurable; above it the link is not the bottleneck anyway and the
-        # ceiling falls back to the reference constant
-        link_bw_mbps = 15.0 / max(t_big - t_small, 0.015)
-        link_fixed_s = max(t_small - 1.0 / link_bw_mbps, 0.0)
-        mode_env = os.environ.get("BENCH_PACKED", "auto")
-        if mode_env == "auto":
-            batch_mb = batch * image_size * image_size * 3 / 1e6
-            packed = fused > 1 and link_fixed_s > batch_mb / link_bw_mbps
-        else:
-            packed = fused > 1 and mode_env == "1"
+    link_ceiling = float("inf")
 
     mesh = parallel.build_mesh({"dp": n_chips})
     strategy = SyncDataParallel(mesh)
@@ -157,12 +127,76 @@ def bench_resnet(tiny, real_data):
             num_threads=int(os.environ.get("BENCH_DATA_THREADS", "16")),
             prefetch_batches=max(4, 2 * fused),
         )
-        if fused > 1 and packed:
-            batches = packed_prefetch(pipe, strategy, fused, depth=1)
-        elif fused > 1:
-            batches = loop_prefetch(pipe, strategy, fused)
+        raw_iter = iter(pipe)
+        # Link-ceiling probe, r4 redesign (decomposition in docs/perf.md):
+        # back-to-back transfers of REAL decoded batches in the run's actual
+        # transfer shape. The r3 probe (min-of-3 zeros at two sizes, fitted
+        # to T = fixed + size/bw) overstated the ceiling ~2x two ways at
+        # once — min-of-N samples the relay's best transient mood while the
+        # workload lives at its sustained rate, and this relay compresses
+        # (zeros ship ~2x faster than image bytes). A ceiling the workload
+        # can never reach makes vs_baseline meaningless; this one is "what
+        # these exact bytes in this exact shape sustained moments earlier".
+        # Tiny (CPU/CI) runs skip the probes: no link, no ceiling to earn.
+        probe_window = [] if tiny else [next(raw_iter) for _ in range(max(fused, 1))]
+
+        def _fence(x):
+            # one-ELEMENT readback: slicing on device first keeps the fence
+            # from shipping the whole array back over the link (a device_get
+            # of the leaf would double the probe's bytes with a D2H copy)
+            leaf = jax.tree.leaves(x)[0]
+            _ = np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
+
+        def _flush_link():
+            # the prefetch pipeline keeps a window's transfer in flight; a
+            # probe timed behind it would charge that leftover to the link —
+            # drain the transfer queue before starting the clock
+            _fence(jax.device_put(np.zeros(1, np.uint8)))
+
+        def probe_per_batch():
+            _flush_link()
+            t0 = time.perf_counter()
+            bufs = [strategy.shard_batch(b) for b in probe_window]
+            for b in bufs:
+                _fence(b)
+            return len(probe_window) * batch / (time.perf_counter() - t0)
+
+        def probe_packed():
+            from tensorflowonspark_tpu.data import packed_place
+
+            _flush_link()
+            t0 = time.perf_counter()
+            buf = packed_place(probe_window, strategy)  # the training path's placement
+            _fence(buf)
+            return len(probe_window) * batch / (time.perf_counter() - t0)
+
+        mode_env = os.environ.get("BENCH_PACKED", "auto")
+        shape_rates = {"per_batch": [], "packed": []}
+        for _ in range(0 if tiny else 2):  # interleaved shape A/B, real payload
+            shape_rates["per_batch"].append(probe_per_batch())
+            if fused > 1:
+                shape_rates["packed"].append(probe_packed())
+        mean_pb = (
+            sum(shape_rates["per_batch"]) / len(shape_rates["per_batch"])
+            if shape_rates["per_batch"] else 0.0
+        )
+        mean_pk = (
+            sum(shape_rates["packed"]) / len(shape_rates["packed"])
+            if shape_rates["packed"] else 0.0
+        )
+        if mode_env == "auto":
+            packed = fused > 1 and mean_pk > mean_pb
         else:
-            batches = device_prefetch(pipe, strategy)
+            packed = fused > 1 and mode_env == "1"
+        link_probe = probe_packed if packed else probe_per_batch
+        link_rates = list(shape_rates["packed" if packed else "per_batch"])
+
+        if fused > 1 and packed:
+            batches = packed_prefetch(raw_iter, strategy, fused, depth=1)
+        elif fused > 1:
+            batches = loop_prefetch(raw_iter, strategy, fused)
+        else:
+            batches = device_prefetch(raw_iter, strategy)
     else:
         rng = np.random.default_rng(0)
         host_batch = {
@@ -194,50 +228,68 @@ def bench_resnet(tiny, real_data):
             state, metrics = run(state, next(batches))
         float(np.asarray(jax.device_get(metrics["loss"])))
 
-        t0 = time.perf_counter()
-        for _ in range(dispatches):
-            state, metrics = run(state, next(batches))
-        # HOST TRANSFER, not block_until_ready: on relayed/tunneled TPU
-        # runtimes block_until_ready can return at the ack, not at compute
-        # completion — the transfer of the last step's loss (which depends
-        # on every prior step) is the only trustworthy fence
-        float(np.asarray(jax.device_get(metrics["loss"])))
-        dt = time.perf_counter() - t0
+        if real_data and not tiny:
+            # probe / run / probe / run / probe: every timed rep is bracketed
+            # by same-shape real-payload link probes, so the ceiling tracks
+            # the relay's mood across the measurement instead of a single
+            # earlier sample (the link swings 3x within minutes — perf.md)
+            import statistics
+            import sys
+
+            reps = int(os.environ.get("BENCH_REPS", "1"))
+            run_rates = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                for _ in range(dispatches):
+                    state, metrics = run(state, next(batches))
+                # HOST TRANSFER, not block_until_ready: on relayed/tunneled
+                # TPU runtimes block_until_ready can return at the ack — the
+                # transfer of the last step's loss (which depends on every
+                # prior step) is the only trustworthy fence
+                float(np.asarray(jax.device_get(metrics["loss"])))
+                run_rates.append(images_measured / (time.perf_counter() - t0))
+                link_rates.append(link_probe())
+            value = statistics.median(run_rates) / n_chips
+            link_ceiling = statistics.median(link_rates) / n_chips
+            print(
+                "resnet_real reps: train {} img/s | link probes {} img/s ({})".format(
+                    [round(v / n_chips, 1) for v in run_rates],
+                    [round(v / n_chips, 1) for v in link_rates],
+                    "packed" if packed else "per-batch",
+                ),
+                file=sys.stderr,
+            )
+        else:
+            t0 = time.perf_counter()
+            for _ in range(dispatches):
+                state, metrics = run(state, next(batches))
+            float(np.asarray(jax.device_get(metrics["loss"])))
+            value = images_measured / (time.perf_counter() - t0) / n_chips
     finally:
         if tmp:
             import shutil
 
             shutil.rmtree(tmp, ignore_errors=True)
 
-    value = images_measured / dt / n_chips
     name = "resnet56_tiny" if tiny else "resnet50"
     suffix = "_realdata" if real_data else ""
     baseline = REFERENCE_IMG_PER_SEC_PER_CHIP
     unit = "images/sec/chip"
-    if real_data and not tiny and link_bw_mbps is not None:
+    if real_data and not tiny and link_ceiling < baseline:
         # Real data must cross the host->device link; when that link is
-        # slower than the chip (relayed/tunneled TPU runtimes), the
-        # feasible ceiling is the link's capability for the CHOSEN transfer
-        # shape — per-batch transfers, or one whole window when packed.
-        # Normalizing against min(reference, link ceiling) makes
-        # vs_baseline read "fraction of this environment's achievable
-        # real-data throughput" (on co-located TPU hosts the probe is fast
-        # and the denominator falls back to the reference constant).
-        batch_mb = batch * image_size * image_size * 3 / 1e6  # uint8 feed
-        per_xfer_imgs = fused * batch if packed else batch
-        per_xfer_mb = fused * batch_mb if packed else batch_mb
-        link_ceiling = (
-            per_xfer_imgs / (link_fixed_s + per_xfer_mb / link_bw_mbps) / n_chips
-        )
-        if link_ceiling < baseline:
-            baseline = link_ceiling
-            unit = (
-                "images/sec/chip (link-limited: {:.0f} MB/s + {:.0f} ms/transfer"
-                "{})".format(
-                    link_bw_mbps, link_fixed_s * 1000,
-                    ", packed windows" if packed else "",
-                )
+        # slower than the chip (relayed/tunneled TPU runtimes), the feasible
+        # ceiling is what the link itself sustained for the SAME bytes in
+        # the SAME transfer shape, probed around the timed reps.
+        # vs_baseline then reads "fraction of this link's achievable
+        # real-data throughput" (on co-located TPU hosts the probes beat
+        # the reference constant and the denominator falls back to it).
+        baseline = link_ceiling
+        unit = (
+            "images/sec/chip (link-limited: sustained same-shape ceiling "
+            "{:.0f} img/s/chip{})".format(
+                link_ceiling, ", packed windows" if packed else ""
             )
+        )
     return {
         "metric": "{}{}_train_images_per_sec_per_chip".format(name, suffix),
         "value": round(value, 2),

@@ -1,0 +1,91 @@
+package com.tensorflowonspark.tpu;
+
+import static org.junit.jupiter.api.Assertions.assertEquals;
+import static org.junit.jupiter.api.Assertions.assertThrows;
+import static org.junit.jupiter.api.Assertions.assertTrue;
+import static org.junit.jupiter.api.Assumptions.assumeTrue;
+
+import java.io.IOException;
+import org.junit.jupiter.api.Test;
+
+/**
+ * Round trips against a LIVE `python -m tensorflowonspark_tpu.serving serve`
+ * (started by scripts/jvm_crosscheck.py, which passes -Dtos.server.host /
+ * -Dtos.server.port). The bundle is the linear y = x @ [[2],[3]] + 1 model
+ * the Python serving tests use, so expected outputs are exact.
+ */
+class InferenceClientTest {
+
+  static String host() {
+    String h = System.getProperty("tos.server.host");
+    return h == null || h.isEmpty() ? "127.0.0.1" : h;
+  }
+
+  static int port() {
+    String p = System.getProperty("tos.server.port");
+    return p == null || p.isEmpty() ? -1 : Integer.parseInt(p);
+  }
+
+  private InferenceClient client() throws IOException {
+    assumeTrue(port() > 0, "no -Dtos.server.port: live-server check skipped");
+    return new InferenceClient(host(), port(), 60_000);
+  }
+
+  @Test
+  void pingAndInfo() throws Exception {
+    try (InferenceClient c = client()) {
+      assertTrue(c.ping());
+      assertTrue(c.info().contains("\"ready\""));
+    }
+  }
+
+  @Test
+  void jsonLanePredict() throws Exception {
+    try (InferenceClient c = client()) {
+      double[][] out = c.predict("x", new double[][] {{1, 1}, {2, 0}});
+      assertEquals(2, out.length);
+      assertEquals(6.0, out[0][0], 1e-6);  // 1*2 + 1*3 + 1
+      assertEquals(5.0, out[1][0], 1e-6);  // 2*2 + 0*3 + 1
+    }
+  }
+
+  @Test
+  void binaryLanePredict() throws Exception {
+    try (InferenceClient c = client()) {
+      float[][] out = c.predictBinary("x", new float[][] {{0f, 0f}, {1f, 2f}, {-1f, 1f}});
+      assertEquals(3, out.length);
+      assertEquals(1.0f, out[0][0], 1e-6f);   // bias only
+      assertEquals(9.0f, out[1][0], 1e-6f);   // 2 + 6 + 1
+      assertEquals(2.0f, out[2][0], 1e-6f);   // -2 + 3 + 1
+    }
+  }
+
+  @Test
+  void serverErrorSurfacesAndConnectionSurvives() throws Exception {
+    try (InferenceClient c = client()) {
+      IOException e =
+          assertThrows(IOException.class, () -> c.predict("nonexistent", new double[][] {{1}}));
+      assertTrue(e.getMessage().contains("server error"), e.getMessage());
+      // the error reply is a lone JSON frame: the SAME connection keeps working
+      assertTrue(c.ping());
+      float[][] out = c.predictBinary("x", new float[][] {{1f, 1f}});
+      assertEquals(6.0f, out[0][0], 1e-6f);
+    }
+  }
+
+  @Test
+  void manySequentialBinaryBatches() throws Exception {
+    try (InferenceClient c = client()) {
+      for (int i = 0; i < 20; i++) {
+        float[][] batch = new float[8][2];
+        for (int r = 0; r < 8; r++) {
+          batch[r][0] = i;
+          batch[r][1] = r;
+        }
+        float[][] out = c.predictBinary("x", batch);
+        assertEquals(8, out.length);
+        assertEquals(2f * i + 3f * 5 + 1f, out[5][0], 1e-5f);
+      }
+    }
+  }
+}

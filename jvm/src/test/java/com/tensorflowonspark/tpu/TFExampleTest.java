@@ -1,0 +1,187 @@
+package com.tensorflowonspark.tpu;
+
+import static org.junit.jupiter.api.Assertions.assertArrayEquals;
+import static org.junit.jupiter.api.Assertions.assertEquals;
+import static org.junit.jupiter.api.Assumptions.assumeTrue;
+
+import java.io.FileInputStream;
+import java.nio.charset.StandardCharsets;
+import java.nio.file.Files;
+import java.nio.file.Path;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+import org.junit.jupiter.api.Test;
+
+/** Example codec: in-JVM round trips + the cross-language golden contract. */
+class TFExampleTest {
+
+  @Test
+  void roundTripAllFeatureKinds() throws Exception {
+    Map<String, Object> features = new LinkedHashMap<>();
+    features.put("label", new long[] {7, -3, Long.MAX_VALUE, Long.MIN_VALUE});
+    features.put("x", new float[] {1.5f, -2.25f, 0f});
+    features.put("raw", new byte[][] {{1, 2, 3}, {}, {(byte) 0xFF}});
+    features.put("name", new String[] {"héllo", ""});
+
+    Map<String, Object> decoded = TFExample.decode(TFExample.encode(features));
+
+    assertArrayEquals((long[]) features.get("label"), (long[]) decoded.get("label"));
+    assertArrayEquals((float[]) features.get("x"), (float[]) decoded.get("x"));
+    byte[][] raw = (byte[][]) decoded.get("raw");
+    assertEquals(3, raw.length);
+    assertArrayEquals(new byte[] {1, 2, 3}, raw[0]);
+    assertArrayEquals(new byte[] {}, raw[1]);
+    byte[][] names = (byte[][]) decoded.get("name");
+    assertEquals("héllo", new String(names[0], StandardCharsets.UTF_8));
+  }
+
+  @Test
+  void scalarConveniencesWidenToLists() throws Exception {
+    Map<String, Object> features = new LinkedHashMap<>();
+    features.put("i", 42);
+    features.put("f", 2.5);
+    features.put("s", "one");
+    Map<String, Object> decoded = TFExample.decode(TFExample.encode(features));
+    assertArrayEquals(new long[] {42}, (long[]) decoded.get("i"));
+    assertArrayEquals(new float[] {2.5f}, (float[]) decoded.get("f"));
+    assertEquals("one", new String(((byte[][]) decoded.get("s"))[0], StandardCharsets.UTF_8));
+  }
+
+  @Test
+  void emptyFeatureRoundTripsLikePython() throws Exception {
+    // Python encodes an empty list as an empty Feature and decodes it as an
+    // empty BytesList; both directions must mirror that
+    Map<String, Object> features = new LinkedHashMap<>();
+    features.put("e", new long[0]);
+    byte[] encoded = TFExample.encode(features);
+    Map<String, Object> decoded = TFExample.decode(encoded);
+    assertEquals(0, ((byte[][]) decoded.get("e")).length);
+    // byte parity: a float/bytes empty encodes identically (no kind field)
+    Map<String, Object> alt = new LinkedHashMap<>();
+    alt.put("e", new float[0]);
+    assertArrayEquals(encoded, TFExample.encode(alt));
+  }
+
+  @Test
+  void decodeAcceptsUnpackedNumericLists() throws Exception {
+    // per-element (unpacked) encodings are legal protobuf for repeated
+    // scalars; some writers emit them. Hand-build: Int64List{1: varint 5,
+    // 1: varint 6} and FloatList{1: fixed32 1.0}
+    byte[] int64List = new byte[] {0x08, 5, 0x08, 6};  // field1 wt0 twice
+    byte[] floatList = new byte[] {0x0D, 0x00, 0x00, (byte) 0x80, 0x3F};  // field1 wt5, 1.0f
+    byte[] example = buildExample("a", 3, int64List, "b", 2, floatList);
+    Map<String, Object> decoded = TFExample.decode(example);
+    assertArrayEquals(new long[] {5, 6}, (long[]) decoded.get("a"));
+    assertArrayEquals(new float[] {1f}, (float[]) decoded.get("b"));
+  }
+
+  /** Example{1: Features{1: entry{1: name, 2: Feature{kindField: list}}}} */
+  private static byte[] buildExample(
+      String n1, int kind1, byte[] list1, String n2, int kind2, byte[] list2) throws Exception {
+    java.io.ByteArrayOutputStream entries = new java.io.ByteArrayOutputStream();
+    for (Object[] item : new Object[][] {{n1, kind1, list1}, {n2, kind2, list2}}) {
+      byte[] name = ((String) item[0]).getBytes(StandardCharsets.UTF_8);
+      byte[] feature = lenDelimited((int) item[1], (byte[]) item[2]);
+      java.io.ByteArrayOutputStream entry = new java.io.ByteArrayOutputStream();
+      entry.write(lenDelimited(1, name));
+      entry.write(lenDelimited(2, feature));
+      entries.write(lenDelimited(1, entry.toByteArray()));
+    }
+    return lenDelimited(1, entries.toByteArray());
+  }
+
+  private static byte[] lenDelimited(int field, byte[] payload) throws Exception {
+    java.io.ByteArrayOutputStream out = new java.io.ByteArrayOutputStream();
+    out.write((field << 3) | 2);
+    int len = payload.length;  // all test payloads < 128: single-byte varint
+    out.write(len);
+    out.write(payload);
+    return out.toByteArray();
+  }
+
+  // -- cross-language golden contract (activated by scripts/jvm_crosscheck.py)
+
+  static Path goldenDir() {
+    String dir = System.getProperty("tos.golden.dir");
+    return dir == null || dir.isEmpty() ? null : Path.of(dir);
+  }
+
+  /**
+   * The golden shard is written by the Python twin
+   * (scripts/jvm_crosscheck.py) with EXACTLY these three records; any
+   * change there must update this test in the same commit.
+   */
+  @Test
+  void decodesPythonWrittenExamples() throws Exception {
+    Path golden = goldenDir();
+    assumeTrue(golden != null, "no -Dtos.golden.dir: cross-language check skipped");
+    List<byte[]> records;
+    try (FileInputStream in = new FileInputStream(golden.resolve("golden-00000").toFile())) {
+      records = TFRecordIO.readAll(in, true);
+    }
+    assertEquals(3, records.size());
+
+    Map<String, Object> r0 = TFExample.decode(records.get(0));
+    assertArrayEquals(new long[] {0, 1, -2}, (long[]) r0.get("label"));
+    assertArrayEquals(new float[] {0.5f, -1.5f}, (float[]) r0.get("x"));
+    assertEquals("zero", new String(((byte[][]) r0.get("tag"))[0], StandardCharsets.UTF_8));
+
+    Map<String, Object> r1 = TFExample.decode(records.get(1));
+    assertArrayEquals(new long[] {1L << 40}, (long[]) r1.get("label"));
+    byte[][] blob = (byte[][]) r1.get("blob");
+    assertArrayEquals(new byte[] {0, 1, 2, 3, (byte) 255}, blob[0]);
+
+    Map<String, Object> r2 = TFExample.decode(records.get(2));
+    float[] xs = (float[]) r2.get("x");
+    assertEquals(784, xs.length);
+    assertEquals(0.25f, xs[42]);
+  }
+
+  /** Java encode must be byte-identical to Python encode_example. */
+  @Test
+  void encodesByteIdenticallyToPython() throws Exception {
+    Path golden = goldenDir();
+    assumeTrue(golden != null, "no -Dtos.golden.dir: cross-language check skipped");
+    Map<String, Object> features = new LinkedHashMap<>();
+    features.put("label", new long[] {0, 1, -2});
+    features.put("x", new float[] {0.5f, -1.5f});
+    features.put("tag", new String[] {"zero"});
+    byte[] mine = TFExample.encode(features);
+    byte[] python;
+    try (FileInputStream in = new FileInputStream(golden.resolve("golden-00000").toFile())) {
+      python = TFRecordIO.readAll(in, true).get(0);
+    }
+    assertArrayEquals(python, mine, "Java encode diverges from Python encode_example");
+  }
+
+  /** Shard round trip: Java-written bytes must re-read identically. */
+  @Test
+  void tfrecordWriteReadRoundTrip() throws Exception {
+    java.io.ByteArrayOutputStream shard = new java.io.ByteArrayOutputStream();
+    Map<String, Object> features = new LinkedHashMap<>();
+    features.put("v", new long[] {9});
+    byte[] rec = TFExample.encode(features);
+    TFRecordIO.writeAll(shard, List.of(rec, rec, rec));
+    List<byte[]> back =
+        TFRecordIO.readAll(new java.io.ByteArrayInputStream(shard.toByteArray()), true);
+    assertEquals(3, back.size());
+    assertArrayEquals(rec, back.get(1));
+  }
+
+  /** Java-written shards must be readable by the Python side: emit one for
+   *  the orchestrator to verify (it checks content + CRCs from Python). */
+  @Test
+  void writesShardForPythonToVerify() throws Exception {
+    Path golden = goldenDir();
+    assumeTrue(golden != null, "no -Dtos.golden.dir: cross-language check skipped");
+    Map<String, Object> features = new LinkedHashMap<>();
+    features.put("label", new long[] {11, 22});
+    features.put("x", new float[] {3.5f});
+    features.put("tag", new String[] {"from-java"});
+    byte[] rec = TFExample.encode(features);
+    try (var out = Files.newOutputStream(golden.resolve("java-written-00000"))) {
+      TFRecordIO.writeAll(out, List.of(rec, rec));
+    }
+  }
+}

@@ -1,0 +1,105 @@
+"""Cross-language verification of the JVM lane (VERDICT r3 item 4).
+
+Orchestrates, in one run:
+
+1. writes the GOLDEN TFRecord shard (three pinned Examples — any change here
+   must update jvm/src/test/java/.../TFExampleTest.java in the same commit);
+2. exports the linear serving bundle and starts a LIVE InferenceServer;
+3. runs ``mvn test`` in jvm/ with -Dtos.golden.dir / -Dtos.server.port, which
+   activates the cross-language + live-server JUnit tests (TFRecord framing
+   vs Python shards, Example decode/encode byte-parity, JSON + binary RPC
+   lanes against the live server);
+4. reads back the shard the Java tests wrote (CRC-verified) and checks its
+   decoded features from Python — both directions of the byte contract.
+
+Requires a JVM + maven (CI: ubuntu-latest); exits nonzero on any failure.
+Run from the repo root: ``python scripts/jvm_crosscheck.py``.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def write_golden(golden_dir):
+    from tensorflowonspark_tpu import tfrecord
+
+    records = [
+        {"label": [0, 1, -2], "x": [0.5, -1.5], "tag": [b"zero"]},
+        {"label": [1 << 40], "blob": [bytes([0, 1, 2, 3, 255])]},
+        {"x": [0.25 if i == 42 else 0.0 for i in range(784)]},
+    ]
+    with tfrecord.TFRecordWriter(os.path.join(golden_dir, "golden-00000")) as w:
+        for features in records:
+            w.write(tfrecord.encode_example(features))
+
+
+def check_java_written(golden_dir):
+    from tensorflowonspark_tpu import tfrecord
+
+    path = os.path.join(golden_dir, "java-written-00000")
+    if not os.path.isfile(path):
+        raise SystemExit("Java tests did not write {}".format(path))
+    recs = list(tfrecord.read_records(path, verify_crc=True))
+    assert len(recs) == 2, len(recs)
+    feats = tfrecord.decode_example(recs[0])  # {name: (kind, values)}
+    assert list(feats["label"][1]) == [11, 22], feats["label"]
+    assert abs(feats["x"][1][0] - 3.5) < 1e-6, feats["x"]
+    assert feats["tag"][1][0] == b"from-java", feats["tag"]
+    print("python side verified the Java-written shard (CRCs + features)")
+
+
+def main():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    jvm_dir = os.path.join(repo, "jvm")
+    if shutil.which("mvn") is None:
+        raise SystemExit("maven not found — run this where a JVM toolchain exists (CI)")
+
+    from tensorflowonspark_tpu.serving import InferenceServer
+    from tensorflowonspark_tpu.train import export
+
+    work = tempfile.mkdtemp(prefix="tos_jvm_crosscheck_")
+    golden = os.path.join(work, "golden")
+    os.makedirs(golden)
+    write_golden(golden)
+
+    # the linear bundle the Python serving tests use: y = x @ [[2],[3]] + 1
+    def predict_builder():
+        def predict(params, model_state, arrays):
+            return {"y_": arrays["x"] @ params["w"] + params["b"]}
+
+        return predict
+
+    bundle = os.path.join(work, "bundle")
+    export.export_model(
+        bundle, predict_builder,
+        {"w": np.array([[2.0], [3.0]], np.float32), "b": np.array([1.0], np.float32)},
+    )
+    server = InferenceServer(bundle)
+    host, port = server.start()
+    try:
+        cmd = [
+            "mvn", "-q", "-B", "test",
+            "-Dtos.golden.dir={}".format(golden),
+            "-Dtos.server.host=127.0.0.1",
+            "-Dtos.server.port={}".format(port),
+        ]
+        print("running:", " ".join(cmd))
+        rc = subprocess.call(cmd, cwd=jvm_dir)
+        if rc != 0:
+            raise SystemExit(rc)
+        check_java_written(golden)
+    finally:
+        server.stop()
+        shutil.rmtree(work, ignore_errors=True)
+    print("jvm crosscheck OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,7 @@ from tensorflowonspark_tpu.data.loader import (  # noqa: F401
     ImagePipeline,
     device_prefetch,
     loop_prefetch,
+    packed_place,
     packed_prefetch,
     shard_files,
 )

@@ -219,6 +219,28 @@ def loop_prefetch(batches, strategy, num_steps, depth=None):
         yield [buf.popleft() for _ in range(num_steps)]
 
 
+def packed_place(window, strategy):
+    """Stack a list of host batches into ONE ``[K, B, ...]`` pytree and ship
+    it as a single sharded host→device transfer — the placement used by
+    :func:`packed_prefetch` and mirrored by bench.py's packed link probe
+    (kept here so the probe can never measure a different shape than the
+    training path)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel.sharding import data_axes
+
+    axes = data_axes(strategy.mesh)
+    spec = P(None, (axes if len(axes) > 1 else axes[0]) if axes else None)
+    sharding = NamedSharding(strategy.mesh, spec)
+    stacked = jax.tree.map(lambda *xs: np.stack(xs), *window)
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x), stacked
+    )
+
+
 def packed_prefetch(batches, strategy, num_steps, depth=1):
     """Group host batches into device-resident ``[num_steps, B, ...]`` stacks,
     each shipped as ONE host→device transfer, double-buffered ``depth``
@@ -233,30 +255,12 @@ def packed_prefetch(batches, strategy, num_steps, depth=1):
     """
     import collections
 
-    import jax
-    import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from tensorflowonspark_tpu.parallel.sharding import data_axes
-
-    axes = data_axes(strategy.mesh)
-    spec = P(None, (axes if len(axes) > 1 else axes[0]) if axes else None)
-    sharding = NamedSharding(strategy.mesh, spec)
-
-    def place(window):
-        stacked = jax.tree.map(lambda *xs: np.stack(xs), *window)
-        if jax.process_count() == 1:
-            return jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)
-        return jax.tree.map(
-            lambda x: jax.make_array_from_process_local_data(sharding, x), stacked
-        )
-
     buf = collections.deque()
     it = iter(batches)
     try:
         while True:
             while len(buf) < depth + 1:
-                buf.append(place([next(it) for _ in range(num_steps)]))
+                buf.append(packed_place([next(it) for _ in range(num_steps)], strategy))
             yield buf.popleft()
     except StopIteration:
         pass

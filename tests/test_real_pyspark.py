@@ -1,0 +1,168 @@
+"""Integration against REAL pyspark (VERDICT r3 items 1 + 7).
+
+Active only when pyspark is importable AND ``TOS_TEST_PYSPARK=1`` (the
+CI pyspark job; ``run_tests.sh`` sets it when pyspark is present —
+reference test/run_tests.sh:16-19 booted the same local-cluster shape).
+Everything here runs on a real ``local-cluster[2,1,1024]``: separate
+executor JVMs with separate python workers, real task scheduling/pickling,
+real ``_jsc`` Hadoop conf, real barrier RDDs, real DStreams.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+pyspark = pytest.importorskip("pyspark")
+pytestmark = pytest.mark.skipif(
+    os.environ.get("TOS_TEST_PYSPARK") != "1",
+    reason="TOS_TEST_PYSPARK=1 not set (real-Spark leg runs in CI)",
+)
+
+# this module is not importable on executors (tests/ is not a package);
+# both pyspark's vendored cloudpickle (task closures) and the standalone
+# cloudpickle (the framework's jax-child spawn) must ship its functions
+# by value
+import cloudpickle
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+try:
+    from pyspark import cloudpickle as _pyspark_cloudpickle
+
+    _pyspark_cloudpickle.register_pickle_by_value(sys.modules[__name__])
+except Exception:
+    pass
+
+from tensorflowonspark_tpu import TFCluster, TFParallel
+from tensorflowonspark_tpu.TFCluster import InputMode
+
+CPU_ENV = {"JAX_PLATFORMS": "cpu"}
+
+
+@pytest.fixture(scope="module")
+def sc():
+    os.environ.setdefault("PYSPARK_PYTHON", sys.executable)
+    os.environ.setdefault("PYSPARK_DRIVER_PYTHON", sys.executable)
+    conf = (
+        pyspark.SparkConf()
+        .setMaster(os.environ.get("MASTER", "local-cluster[2,1,1024]"))
+        .setAppName("tos-tpu-real-spark")
+        .set("spark.task.maxFailures", "1")
+        .set("spark.executorEnv.JAX_PLATFORMS", "cpu")
+        .set("spark.python.worker.reuse", "true")
+    )
+    context = pyspark.SparkContext(conf=conf)
+    context.setLogLevel("WARN")
+    yield context
+    context.stop()
+
+
+def test_default_fs_through_real_jvm_hadoop_conf(sc):
+    fs = TFCluster.resolve_default_fs(sc)
+    assert fs is not None and fs.startswith("file:"), fs
+
+
+def fn_write_marker(args, ctx):
+    with open(os.path.join(args["out_dir"], "node{}.json".format(ctx.executor_id)), "w") as f:
+        json.dump({"job": ctx.job_name, "index": ctx.task_index,
+                   "workers": ctx.num_workers}, f)
+
+
+def test_cluster_lifecycle_tensorflow_mode(sc, tmp_path):
+    """run → assemble over real executors → map_fun in jax children →
+    shutdown; the full reference launch path (TFSparkNode.py:240-333) on
+    actual Spark task scheduling and pickling."""
+    cluster = TFCluster.run(
+        sc, fn_write_marker, {"out_dir": str(tmp_path)}, num_executors=2,
+        input_mode=InputMode.TENSORFLOW, master_node=None,
+        env=CPU_ENV, jax_distributed=False, reservation_timeout=300,
+    )
+    cluster.shutdown(timeout=300)
+    nodes = sorted(os.listdir(str(tmp_path)))
+    assert nodes == ["node0.json", "node1.json"], nodes
+    with open(tmp_path / "node0.json") as f:
+        assert json.load(f)["workers"] == 2
+
+
+def fn_count_feed(args, ctx):
+    out = os.path.join(args["out_dir"], "sum{}.txt".format(ctx.executor_id))
+    feed = ctx.get_data_feed(train_mode=True)
+    total = 0
+    while not feed.should_stop():
+        rows = feed.next_batch(16)
+        total += sum(int(r[1]) for r in rows if r is not None)
+        with open(out, "w") as f:  # running total: the driver polls this
+            f.write(str(total))
+
+
+def test_cluster_spark_mode_feed(sc, tmp_path):
+    """InputMode.SPARK on real Spark: foreachPartition feed tasks land on
+    real executors and reach the executor-local channel of whichever node
+    lives there."""
+    cluster = TFCluster.run(
+        sc, fn_count_feed, {"out_dir": str(tmp_path)}, num_executors=2,
+        input_mode=InputMode.SPARK, master_node=None,
+        env=CPU_ENV, jax_distributed=False, reservation_timeout=300,
+    )
+    rows = [("r{}".format(i), 1) for i in range(64)]
+    cluster.train(sc.parallelize(rows, 4), num_epochs=1, feed_timeout=300)
+    cluster.shutdown(grace_secs=2, timeout=300)
+    sums = []
+    for name in sorted(os.listdir(str(tmp_path))):
+        with open(tmp_path / name) as f:
+            sums.append(int(f.read()))
+    assert sum(sums) == 64, sums  # every row consumed exactly once
+
+
+def test_streaming_foreachrdd_single_arg(sc, tmp_path):
+    """Micro-batch feeding through a REAL DStream (VERDICT r3 item 7): pins
+    the foreachRDD arity subtlety — pyspark inspects co_argcount and passes
+    (batch_time, rdd) to 2-arg functions, so TFCluster.train's callback must
+    take exactly one positional arg (TFCluster.py train(); reference
+    mnist_spark_streaming.py:84-144)."""
+    streaming = pytest.importorskip(
+        "pyspark.streaming", reason="DStreams removed in Spark 4; CI pins pyspark<4"
+    )
+    cluster = TFCluster.run(
+        sc, fn_count_feed, {"out_dir": str(tmp_path)}, num_executors=2,
+        input_mode=InputMode.SPARK, master_node=None,
+        env=CPU_ENV, jax_distributed=False, reservation_timeout=300,
+    )
+    ssc = streaming.StreamingContext(sc, 1)
+    waves = [sc.parallelize([("w{}".format(w), 1) for _ in range(8)], 2) for w in range(3)]
+    cluster.train(ssc.queueStream(waves), feed_timeout=300)
+    ssc.start()
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        done = [f for f in os.listdir(str(tmp_path)) if f.startswith("sum")]
+        if len(done) == 2 and _sum_files(tmp_path) >= 24:
+            break
+        time.sleep(1)
+    cluster.shutdown(ssc=ssc, grace_secs=2, timeout=300)
+    assert _sum_files(tmp_path) == 24  # 3 waves x 8 rows, each consumed once
+
+
+def _sum_files(tmp_path):
+    total = 0
+    for name in os.listdir(str(tmp_path)):
+        if name.startswith("sum"):
+            with open(os.path.join(str(tmp_path), name)) as f:
+                text = f.read().strip()
+                total += int(text) if text else 0
+    return total
+
+
+def fn_instance(args, ctx):
+    with open(os.path.join(args["out_dir"], "inst{}.txt".format(ctx.executor_id)), "w") as f:
+        f.write("{}/{}".format(ctx.executor_id, ctx.num_workers))
+
+
+def test_tfparallel_barrier_on_real_spark(sc, tmp_path):
+    """TFParallel.run on real pyspark uses barrier-mode scheduling
+    (reference TFParallel.py:63-64); local-cluster has exactly the 2 slots
+    the 2 barrier tasks need."""
+    done = TFParallel.run(sc, fn_instance, {"out_dir": str(tmp_path)}, 2, env=CPU_ENV)
+    assert sorted(done) == [0, 1]
+    assert sorted(os.listdir(str(tmp_path))) == ["inst0.txt", "inst1.txt"]
