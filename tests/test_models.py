@@ -87,6 +87,21 @@ class TestResNet:
         logits = model.apply(variables, jnp.zeros((2, 64, 64, 3)), train=False)
         assert logits.shape == (2, 1000)
 
+    def test_resnet50_s2d_stem_matches_shapes_and_trains(self):
+        """The space-to-depth stem (docs/perf.md r4 breakdown) halves the
+        spatial dims exactly like the 7x7/2 stem, so every downstream stage
+        sees identical shapes; one train step must run and mutate stats."""
+        model = resnet.resnet50(num_classes=1000, stem="imagenet_s2d")
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)), train=False)
+        # stem kernel consumes the 2x2-block channels: (4, 4, 12, 64)
+        assert variables["params"]["stem"]["kernel"].shape == (4, 4, 12, 64)
+        logits = model.apply(variables, jnp.zeros((2, 64, 64, 3)), train=False)
+        assert logits.shape == (2, 1000)
+        logits, new_state = model.apply(
+            variables, jnp.zeros((2, 64, 64, 3)), train=True, mutable=["batch_stats"]
+        )
+        assert logits.shape == (2, 1000) and "batch_stats" in new_state
+
 
 class TestSegmentation:
     def test_unet_train_step(self):
