@@ -5,6 +5,7 @@ import java.io.DataInputStream;
 import java.io.DataOutputStream;
 import java.io.IOException;
 import java.net.Socket;
+import java.nio.ByteBuffer;
 import java.nio.charset.StandardCharsets;
 import java.util.ArrayList;
 import java.util.List;
@@ -131,20 +132,11 @@ public final class InferenceClient implements Closeable {
     out.write(payload.array());
     out.flush();
 
-    int length = in.readInt();
-    if (length < 0 || length > (64 << 20)) throw new IOException("bad reply length " + length);
-    byte[] reply = new byte[length];
-    in.readFully(reply);
-    String text = new String(reply, StandardCharsets.UTF_8);
-    String type = topLevelType(text);
-    if ("error".equals(type)) throw new IOException("server error: " + text);
-    if (!"result_binary".equals(type)) throw new IOException("unexpected reply: " + text);
-    // drain the raw frame BEFORE validating the header: a validation throw
-    // must leave the persistent connection positioned at the next message
-    int blen = in.readInt();
-    if (blen < 0) throw new IOException("bad binary frame length " + blen);
-    byte[] raw = new byte[blen];
-    in.readFully(raw);
+    // shared reply contract (raw frame drained even on validation throws,
+    // so the persistent connection stays positioned at the next message)
+    BinaryReply result = readBinaryReply();
+    String text = result.header;
+    byte[] raw = result.raw;
     // first column's dtype + shape (fixed message shape; minimal parsing)
     String dtype = extractString(text, "\"dtype\"");
     int[] shape = extract2dShape(text);
@@ -161,6 +153,202 @@ public final class InferenceClient implements Closeable {
     return result;
   }
 
+  /**
+   * One named tensor on the binary lane: numpy dtype string ({@code <f4},
+   * {@code <f8}, {@code <i4}, {@code <i8}), shape, and a C-contiguous
+   * little-endian buffer — the nio-buffer tensor shape of the reference's
+   * Scala TFModel (TFModel.scala:51-244 batch2tensors/tensors2batch).
+   */
+  public static final class Column {
+    public final String name;
+    public final String dtype;
+    public final int[] shape;
+    public final ByteBuffer data;
+
+    public Column(String name, String dtype, int[] shape, ByteBuffer data) {
+      this.name = name;
+      this.dtype = dtype;
+      this.shape = shape;
+      this.data = data;
+    }
+
+    public static Column ofFloats(String name, int[] shape, float[] values) {
+      ByteBuffer b = ByteBuffer.allocate(values.length * 4).order(java.nio.ByteOrder.LITTLE_ENDIAN);
+      for (float v : values) b.putFloat(v);
+      b.flip();
+      return new Column(name, "<f4", shape, b);
+    }
+
+    public static Column ofLongs(String name, int[] shape, long[] values) {
+      ByteBuffer b = ByteBuffer.allocate(values.length * 8).order(java.nio.ByteOrder.LITTLE_ENDIAN);
+      for (long v : values) b.putLong(v);
+      b.flip();
+      return new Column(name, "<i8", shape, b);
+    }
+
+    public int elementCount() {
+      int n = 1;
+      for (int d : shape) n *= d;
+      return n;
+    }
+
+    public int byteSize() {
+      return elementCount() * Integer.parseInt(dtype.substring(2));
+    }
+
+    public float[] floats() {
+      ByteBuffer b = data.duplicate().order(java.nio.ByteOrder.LITTLE_ENDIAN);
+      float[] out = new float[elementCount()];
+      boolean f8 = "<f8".equals(dtype);
+      for (int i = 0; i < out.length; i++) out[i] = f8 ? (float) b.getDouble() : b.getFloat();
+      return out;
+    }
+
+    public long[] longs() {
+      ByteBuffer b = data.duplicate().order(java.nio.ByteOrder.LITTLE_ENDIAN);
+      long[] out = new long[elementCount()];
+      boolean i4 = "<i4".equals(dtype);
+      for (int i = 0; i < out.length; i++) out[i] = i4 ? b.getInt() : b.getLong();
+      return out;
+    }
+  }
+
+  /**
+   * Generic binary-lane predict: any number of input columns, any of the
+   * four wire dtypes, N-D shapes — full class-parity with the reference's
+   * JVM tensor path. Returns every output column with its dtype and shape.
+   */
+  public List<Column> predictBinaryColumns(List<Column> inputs) throws IOException {
+    // validate BEFORE writing anything: a mismatch detected mid-send would
+    // leave the persistent connection desynchronized for every later call
+    for (Column c : inputs) {
+      if (c.data.remaining() != c.byteSize()) {
+        throw new IllegalArgumentException(
+            "column " + c.name + ": buffer holds " + c.data.remaining()
+                + " bytes but dtype " + c.dtype + " x shape needs " + c.byteSize());
+      }
+    }
+    StringBuilder header = new StringBuilder("{\"type\": \"predict_binary\", \"columns\": [");
+    int total = 0;
+    for (int i = 0; i < inputs.size(); i++) {
+      Column c = inputs.get(i);
+      if (i > 0) header.append(", ");
+      header.append("{\"name\": \"").append(c.name)
+          .append("\", \"dtype\": \"").append(c.dtype).append("\", \"shape\": [");
+      for (int d = 0; d < c.shape.length; d++) {
+        if (d > 0) header.append(", ");
+        header.append(c.shape[d]);
+      }
+      header.append("]}");
+      total += c.byteSize();
+    }
+    header.append("]}");
+    byte[] hb = header.toString().getBytes(StandardCharsets.UTF_8);
+    out.writeInt(hb.length);
+    out.write(hb);
+    out.writeInt(total);
+    for (Column c : inputs) {
+      ByteBuffer b = c.data.duplicate();
+      byte[] chunk = new byte[c.byteSize()];
+      b.get(chunk);
+      out.write(chunk);
+    }
+    out.flush();
+
+    BinaryReply reply = readBinaryReply();
+    List<Column> outputs = new ArrayList<>();
+    int offset = 0;
+    for (String obj : columnObjects(reply.header)) {
+      String name = extractString(obj, "\"name\"");
+      String dtype = extractString(obj, "\"dtype\"");
+      int[] shape = extractShape(obj);
+      int size = new Column(name, dtype, shape, ByteBuffer.allocate(0)).byteSize();
+      if (offset + size > reply.raw.length) {
+        throw new IOException("binary frame shorter than header claims");
+      }
+      ByteBuffer slice =
+          ByteBuffer.wrap(reply.raw, offset, size).slice().order(java.nio.ByteOrder.LITTLE_ENDIAN);
+      outputs.add(new Column(name, dtype, shape, slice));
+      offset += size;
+    }
+    return outputs;
+  }
+
+  /** The result_binary reply pair: validated JSON header + raw frame. */
+  static final class BinaryReply {
+    final String header;
+    final byte[] raw;
+
+    BinaryReply(String header, byte[] raw) {
+      this.header = header;
+      this.raw = raw;
+    }
+  }
+
+  /** Reads + validates one result_binary reply (header frame, error
+   *  dispatch, bounded raw frame) — the single copy of the reply wire
+   *  contract shared by both binary predict paths. */
+  private BinaryReply readBinaryReply() throws IOException {
+    int length = in.readInt();
+    if (length < 0 || length > (64 << 20)) throw new IOException("bad reply length " + length);
+    byte[] reply = new byte[length];
+    in.readFully(reply);
+    String text = new String(reply, StandardCharsets.UTF_8);
+    String type = topLevelType(text);
+    if ("error".equals(type)) throw new IOException("server error: " + text);
+    if (!"result_binary".equals(type)) throw new IOException("unexpected reply: " + text);
+    int blen = in.readInt();
+    if (blen < 0 || blen > (1 << 30)) throw new IOException("bad binary frame length " + blen);
+    byte[] raw = new byte[blen];
+    in.readFully(raw);
+    return new BinaryReply(text, raw);
+  }
+
+  /** The {@code {...}} objects of the top-level {@code "columns"} array
+   *  (fixed message shape: flat objects, no nesting inside). */
+  static List<String> columnObjects(String s) throws IOException {
+    int i = s.indexOf("\"columns\"");
+    if (i < 0) throw new IOException("missing columns in: " + s);
+    int open = s.indexOf('[', i);
+    int close = matchSquare(s, open);
+    List<String> out = new ArrayList<>();
+    int j = open + 1;
+    while (j < close) {
+      int objOpen = s.indexOf('{', j);
+      if (objOpen < 0 || objOpen > close) break;
+      int objClose = s.indexOf('}', objOpen);
+      if (objClose < 0 || objClose > close) {
+        throw new IOException("truncated column object in: " + s);
+      }
+      out.add(s.substring(objOpen, objClose + 1));
+      j = objClose + 1;
+    }
+    return out;
+  }
+
+  static int matchSquare(String s, int open) throws IOException {
+    int depth = 0;
+    for (int i = open; i < s.length(); i++) {
+      char ch = s.charAt(i);
+      if (ch == '[') depth++;
+      if (ch == ']' && --depth == 0) return i;
+    }
+    throw new IOException("unbalanced brackets in: " + s);
+  }
+
+  static int[] extractShape(String obj) throws IOException {
+    int i = obj.indexOf("\"shape\"");
+    if (i < 0) throw new IOException("missing shape in: " + obj);
+    int open = obj.indexOf('[', i);
+    int close = obj.indexOf(']', open);
+    String inner = obj.substring(open + 1, close).trim();
+    if (inner.isEmpty()) return new int[0];
+    String[] parts = inner.split(",");
+    int[] shape = new int[parts.length];
+    for (int d = 0; d < parts.length; d++) shape[d] = Integer.parseInt(parts[d].trim());
+    return shape;
+  }
+
   static String extractString(String s, String key) throws IOException {
     int i = s.indexOf(key);
     if (i < 0) throw new IOException("missing " + key + " in: " + s);
@@ -170,19 +358,15 @@ public final class InferenceClient implements Closeable {
   }
 
   static int[] extract2dShape(String s) throws IOException {
-    int i = s.indexOf("\"shape\"");
-    if (i < 0) throw new IOException("missing shape in: " + s);
-    int open = s.indexOf('[', i);
-    int close = s.indexOf(']', open);
-    String[] parts = s.substring(open + 1, close).split(",");
-    if (parts.length == 1) {  // 1-D output: treat as [rows, 1]
-      return new int[] {Integer.parseInt(parts[0].trim()), 1};
+    int[] shape = extractShape(s);
+    if (shape.length == 1) {  // 1-D output: treat as [rows, 1]
+      return new int[] {shape[0], 1};
     }
-    if (parts.length > 2) {  // never truncate silently; use predictRaw for N-D
-      throw new IOException("predictBinary supports 1-D/2-D outputs; got shape "
-          + s.substring(open, close + 1));
+    if (shape.length != 2) {  // never truncate silently; N-D goes through
+      throw new IOException(   // predictBinaryColumns
+          "predictBinary supports 1-D/2-D outputs; got rank " + shape.length);
     }
-    return new int[] {Integer.parseInt(parts[0].trim()), Integer.parseInt(parts[1].trim())};
+    return shape;
   }
 
   @Override

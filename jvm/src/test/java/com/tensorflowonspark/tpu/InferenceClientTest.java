@@ -74,6 +74,25 @@ class InferenceClientTest {
   }
 
   @Test
+  void genericBinaryColumnsMultiDtype() throws Exception {
+    // two input columns of different dtypes (f32 matrix + i64 per-row
+    // offsets) through the generic lane — the reference TFModel.scala
+    // batch2tensors/tensors2batch class of capability
+    try (InferenceClient c = client()) {
+      java.util.List<InferenceClient.Column> outs = c.predictBinaryColumns(java.util.List.of(
+          InferenceClient.Column.ofFloats("x", new int[] {2, 2}, new float[] {1f, 1f, 0f, 0f}),
+          InferenceClient.Column.ofLongs("z", new int[] {2, 1}, new long[] {10, -4})));
+      assertEquals(1, outs.size());
+      InferenceClient.Column y = outs.get(0);
+      assertEquals("y_", y.name);
+      assertEquals(2, y.shape[0]);
+      float[] vals = y.floats();
+      assertEquals(16.0f, vals[0], 1e-5f);  // 2+3+1+10
+      assertEquals(-3.0f, vals[1], 1e-5f);  // 1-4
+    }
+  }
+
+  @Test
   void manySequentialBinaryBatches() throws Exception {
     try (InferenceClient c = client()) {
       for (int i = 0; i < 20; i++) {

@@ -69,10 +69,15 @@ def main():
     os.makedirs(golden)
     write_golden(golden)
 
-    # the linear bundle the Python serving tests use: y = x @ [[2],[3]] + 1
+    # the linear bundle the Python serving tests use — y = x @ [[2],[3]] + 1 —
+    # plus an OPTIONAL int64 column "z" added row-wise, so the JUnit generic
+    # binary-columns test can exercise multi-column multi-dtype requests
     def predict_builder():
         def predict(params, model_state, arrays):
-            return {"y_": arrays["x"] @ params["w"] + params["b"]}
+            y = arrays["x"] @ params["w"] + params["b"]
+            if "z" in arrays:
+                y = y + arrays["z"].astype(y.dtype)
+            return {"y_": y}
 
         return predict
 

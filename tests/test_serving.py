@@ -532,3 +532,41 @@ def test_trusted_builder_refuses_legacy_checkpoint_bundle(tmp_path):
         f.write(b"irrelevant")
     with pytest.raises(ValueError, match="legacy checkpoint"):
         export_mod.load_model(path, trusted_builder=_linear_builder)
+
+
+def test_binary_lane_mixed_dtype_columns(tmp_path):
+    """Python twin of the JVM genericBinaryColumnsMultiDtype JUnit test
+    (and of scripts/jvm_crosscheck.py's bundle): an f32 matrix + an i64
+    per-row column through the binary lane in one request."""
+    from tensorflowonspark_tpu.serving import InferenceClient, InferenceServer
+    from tensorflowonspark_tpu.train import export as export_mod
+
+    def builder():
+        def predict(params, model_state, arrays):
+            y = arrays["x"] @ params["w"] + params["b"]
+            if "z" in arrays:
+                y = y + arrays["z"].astype(y.dtype)
+            return {"y_": y}
+
+        return predict
+
+    path = str(tmp_path / "mixed")
+    export_mod.export_model(
+        path, builder,
+        {"w": np.array([[2.0], [3.0]], np.float32), "b": np.array([1.0], np.float32)},
+    )
+    srv = InferenceServer(path)
+    srv.start()
+    try:
+        client = InferenceClient(srv.address)
+        out = client.predict_binary(
+            x=np.array([[1, 1], [0, 0]], np.float32),
+            z=np.array([[10], [-4]], np.int64),
+        )
+        np.testing.assert_allclose(out["y_"], [[16.0], [-3.0]])
+        # without z the same bundle serves the plain linear model
+        out2 = client.predict_binary(x=np.array([[1, 1]], np.float32))
+        np.testing.assert_allclose(out2["y_"], [[6.0]])
+        client.close()
+    finally:
+        srv.stop()

@@ -85,15 +85,24 @@ def test_discard_unlinks_without_reading():
     chunk.discard()  # idempotent
 
 
-def test_unlink_leaked_age_gate(tmp_path):
+def test_unlink_leaked_age_gate(tmp_path, monkeypatch):
+    # isolate the janitor's namespace: /dev/shm is shared with concurrent
+    # xdist workers' live feed segments and with stale leaks from other
+    # (killed) runs — scan/reap only this test's own prefix
+    import os
+
+    import tensorflowonspark_tpu.shm as shm_mod
+
+    monkeypatch.setattr(shm_mod, "NAME_PREFIX", "tosfeedtest{}_".format(os.getpid()))
     chunk = ShmChunk.from_rows([(1.0, 2.0)])
     try:
-        # too young: janitor must not touch it
+        # too young: janitor must not touch it (membership checked against
+        # the raw dir: _segments() filters by the UNPATCHED prefix)
         assert unlink_leaked(max_age_secs=3600) == 0
-        assert chunk.name in _segments()
+        assert chunk.name in os.listdir("/dev/shm")
         # old enough: reaped
         assert unlink_leaked(max_age_secs=0) >= 1
-        assert chunk.name not in _segments()
+        assert chunk.name not in os.listdir("/dev/shm")
     finally:
         chunk.discard()
 
