@@ -36,10 +36,19 @@ def main_fun(args, ctx):
     optimizer = optax.adam(args.learning_rate)
     state = strategy.create_state(mnist.make_init_fn(model), optimizer, jax.random.PRNGKey(0))
     step = strategy.compile_train_step(mnist.make_loss_fn(model), optimizer, has_aux=True)
+    start_step = 0
+    if args.model_dir:
+        # resume contract (run_with_recovery / job resubmission): continue
+        # from the newest checkpoint; sharded target = shard-direct restore
+        latest = checkpoint.latest_checkpoint(args.model_dir)
+        if latest:
+            state = checkpoint.restore_checkpoint(latest, target=state)
+            start_step = int(jax.device_get(state.step))
+            print("resuming from {} at step {}".format(latest, start_step))
 
     max_steps = steps_per_worker(args.num_examples * args.epochs, args.batch_size, ctx.num_workers)
     feed = ctx.get_data_feed(train_mode=True)
-    steps = 0
+    steps = start_step
     while not feed.should_stop() and steps < max_steps:
         batch = feed.next_batch(args.batch_size)
         if not batch:

@@ -42,6 +42,24 @@ def lr_schedule(args):
     return optax.linear_schedule(0.0, base, warmup)
 
 
+def _prune_checkpoints(model_dir, keep):
+    """Keep the newest ``keep`` ckpt_* dirs (params + momentum add up fast
+    on long runs; only the latest feeds the resume contract). Concurrent
+    pruning by multiple saver processes is harmless — deletions race only
+    against each other, on dirs nobody reads again."""
+    import shutil
+
+    if keep <= 0:
+        return
+    numbered = []
+    for name in os.listdir(model_dir):
+        tail = name.rsplit("_", 1)[-1]
+        if name.startswith("ckpt_") and tail.isdigit():
+            numbered.append((int(tail), name))
+    for _, name in sorted(numbered)[:-keep]:
+        shutil.rmtree(os.path.join(model_dir, name), ignore_errors=True)
+
+
 def main_fun(args, ctx):
     import time
 
@@ -78,6 +96,23 @@ def main_fun(args, ctx):
         model, weight_decay=1e-4,
         normalize=imagenet_mod.device_normalize if feed_uint8 else None,
     )
+    # distributed worlds: EVERY process must join the (collective) save;
+    # independent workers: only the chief writes, or they race on the dir
+    is_saver = ctx.distributed or ctx.job_name in ("chief", "master") or ctx.num_workers <= 1
+    start_step = 0
+    from tensorflowonspark_tpu.train import checkpoint
+
+    if args.model_dir:
+        latest = checkpoint.latest_checkpoint(args.model_dir)
+        if latest:
+            # the crash→relaunch contract (TFCluster.run_with_recovery and
+            # plain job resubmission both land here): pick up the trajectory
+            # at the newest checkpoint instead of step 0. The live sharded
+            # state is the restore target, so orbax restores each shard
+            # straight onto its mesh device — no full-array host round trip
+            state = checkpoint.restore_checkpoint(latest, target=state)
+            start_step = int(jax.device_get(state.step))
+            print("resuming from {} at step {}".format(latest, start_step))
     steps_per_loop = max(int(getattr(args, "steps_per_loop", 1) or 1), 1)
     if steps_per_loop > 1:
         # K steps fused into one lax.scan dispatch; transfers overlap compute.
@@ -139,7 +174,7 @@ def main_fun(args, ctx):
         profile_range = (int(lo), int(hi or lo))
 
     t0, metrics = time.perf_counter(), {}
-    i = last_log = 0
+    i = last_log = last_ckpt = start_step
     profiling = False
     while i < args.train_steps:
         if profile_range and not profiling and i >= profile_range[0]:
@@ -158,6 +193,15 @@ def main_fun(args, ctx):
             profiling = False
             profile_range = None  # captured once; never re-trigger
             print("profiler trace written to {}".format(trace_dir))
+        if args.model_dir and args.checkpoint_steps and is_saver and (
+            i - last_ckpt >= args.checkpoint_steps
+        ):
+            jax.block_until_ready(metrics["loss"])
+            checkpoint.save_checkpoint(
+                os.path.join(args.model_dir, "ckpt_{}".format(i)), jax.device_get(state)
+            )
+            last_ckpt = i
+            _prune_checkpoints(args.model_dir, args.keep_checkpoints)
         if i - last_log >= args.log_steps:
             jax.block_until_ready(metrics["loss"])
             dt = time.perf_counter() - t0
@@ -173,9 +217,7 @@ def main_fun(args, ctx):
     if metrics:
         jax.block_until_ready(metrics["loss"])
         print("final loss {:.3f}".format(float(metrics["loss"])))
-        if args.model_dir and (ctx.distributed or ctx.job_name in ("chief", "master")):
-            from tensorflowonspark_tpu.train import checkpoint
-
+        if args.model_dir and is_saver and last_ckpt < args.train_steps:
             checkpoint.save_checkpoint(
                 os.path.join(args.model_dir, "ckpt_{}".format(args.train_steps)),
                 jax.device_get(state),
@@ -248,7 +290,21 @@ def main(argv=None):
                         help="force the synthetic path even when --data_dir is given; "
                              "synthetic is also the default when no --data_dir is set")
     parser.add_argument("--platform", default=None)
+    parser.add_argument("--checkpoint_steps", type=int, default=0, metavar="N",
+                        help="checkpoint every N steps into --model_dir "
+                             "(0 = final checkpoint only)")
+    parser.add_argument("--keep_checkpoints", type=int, default=5, metavar="K",
+                        help="retain only the newest K periodic checkpoints")
+    parser.add_argument("--auto_recover", type=int, default=0, metavar="N",
+                        help="relaunch the cluster up to N times on node "
+                             "failure, resuming from the latest checkpoint "
+                             "(pair with --model_dir + --checkpoint_steps; "
+                             "TFCluster.run_with_recovery)")
     args = parser.parse_args(argv)
+    if args.auto_recover and not (args.model_dir and args.checkpoint_steps):
+        # without a mid-run checkpoint to resume from, every relaunch would
+        # silently restart at step 0 — refuse the misconfiguration up front
+        parser.error("--auto_recover requires --model_dir and --checkpoint_steps")
 
     from tensorflowonspark_tpu import TFCluster
     from tensorflowonspark_tpu.backends.local import LocalSparkContext
@@ -256,12 +312,21 @@ def main(argv=None):
     sc = LocalSparkContext(num_executors=args.cluster_size)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     try:
-        cluster = TFCluster.run(
-            sc, main_fun, args, args.cluster_size,
-            input_mode=TFCluster.InputMode.TENSORFLOW, master_node="chief", env=env,
-        )
-        cluster.shutdown()
-        print("resnet training complete")
+        if args.auto_recover:
+            relaunches = TFCluster.run_with_recovery(
+                sc, main_fun, args, args.cluster_size,
+                max_relaunches=args.auto_recover,
+                input_mode=TFCluster.InputMode.TENSORFLOW, master_node="chief",
+                env=env,
+            )
+            print("resnet training complete ({} relaunch(es))".format(relaunches))
+        else:
+            cluster = TFCluster.run(
+                sc, main_fun, args, args.cluster_size,
+                input_mode=TFCluster.InputMode.TENSORFLOW, master_node="chief", env=env,
+            )
+            cluster.shutdown()
+            print("resnet training complete")
     finally:
         sc.stop()
 

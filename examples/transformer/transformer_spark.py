@@ -67,6 +67,15 @@ def main_fun(args, ctx):
         transformer.make_init_fn(model, sample_len=8), optimizer, jax.random.PRNGKey(0)
     )
     loss_fn = transformer.make_loss_fn(model)
+    start_step = 0
+    if args.model_dir:
+        # resume contract (run_with_recovery / job resubmission): continue
+        # from the newest checkpoint; sharded target = shard-direct restore
+        latest = checkpoint.latest_checkpoint(args.model_dir)
+        if latest:
+            state = checkpoint.restore_checkpoint(latest, target=state)
+            start_step = int(jax.device_get(state.step))
+            print("resuming from {} at step {}".format(latest, start_step))
     steps_per_loop = max(args.steps_per_loop, 1)
     if steps_per_loop > 1:
         run = strategy.compile_train_loop(
@@ -87,7 +96,7 @@ def main_fun(args, ctx):
 
     batches = token_batches()
     t0, metrics = time.perf_counter(), {}
-    i = 0
+    i = start_step
     while i < args.train_steps:
         if steps_per_loop > 1 and i + steps_per_loop <= args.train_steps:
             state, metrics = run(state, [next(batches) for _ in range(steps_per_loop)])
@@ -98,7 +107,7 @@ def main_fun(args, ctx):
         if i % args.log_steps == 0 or i >= args.train_steps:
             jax.block_until_ready(metrics["loss"])
             dt = time.perf_counter() - t0
-            tps = args.batch_size * args.seq_len * i / dt
+            tps = args.batch_size * args.seq_len * (i - start_step) / dt
             print("step {}: loss {:.3f} ({:.0f} tokens/s)".format(
                 i, float(metrics["loss"]), tps))
     if args.model_dir and (ctx.distributed or ctx.executor_id == 0):

@@ -186,3 +186,23 @@ def test_mnist_pipeline_then_parallel_inference(tmp_path):
     )
     assert "inference shards in" in out2
     assert os.listdir(pred_out)
+
+
+@pytest.mark.slow
+def test_resnet_checkpoint_resume_and_auto_recover(tmp_path):
+    """The crash→resubmit story at the example level: run 1 checkpoints
+    every 2 steps and stops at 4; run 2 (--auto_recover engages
+    TFCluster.run_with_recovery) resumes at step 4 and finishes 6."""
+    model_dir = str(tmp_path / "ckpts")
+    common = [
+        "resnet/resnet_spark.py", "--dataset", "cifar", "--batch_size", "8",
+        "--log_steps", "1", "--dtype", "fp32", "--platform", "cpu",
+        "--model_dir", model_dir, "--checkpoint_steps", "2",
+    ]
+    out1 = _run(*common, "--train_steps", "4")
+    assert "resnet training complete" in out1
+    assert sorted(os.listdir(model_dir)) == ["ckpt_2", "ckpt_4"]
+    out2 = _run(*common, "--train_steps", "6", "--auto_recover", "1")
+    assert "resuming from" in out2 and "at step 4" in out2
+    assert "resnet training complete (0 relaunch(es))" in out2
+    assert "ckpt_6" in os.listdir(model_dir)

@@ -136,8 +136,12 @@ def test_run_with_recovery_completes_with_parked_ps_role(tmp_path):
     finally:
         sc.stop()
     assert relaunches == 0
-    # the worker ran; the ps parked and was released at shutdown
-    assert sorted(f for f in os.listdir(d) if f.startswith("ran_")) == ["ran_0", "ran_1"]
+    # the WORKER ran to completion. (No assertion on the ps node's file: ps
+    # is a service role — shutdown terminates its child the moment the
+    # workers finish, which can be before a slow-booting ps child even
+    # reaches user code; the reference's ps sat in server.join() and was
+    # killed the same way, TFSparkNode.py:373-390.)
+    assert "ran_1" in os.listdir(d)
 
 
 def test_run_with_recovery_rejects_spark_mode():
