@@ -182,6 +182,23 @@ def test_loop_prefetch_windows_and_drops_remainder():
         np.testing.assert_allclose(np.asarray(got["x"]), want["x"])
 
 
+def test_packed_prefetch_stacks_and_shards_windows():
+    """packed_place (shared by packed_prefetch and bench.py's packed link
+    probe): K host batches -> ONE [K, B, ...] device tree, batch dim sharded
+    over the data axes; short final windows are dropped."""
+    from tensorflowonspark_tpu.data import packed_prefetch
+
+    mesh = parallel.build_mesh({"dp": 8})
+    strategy = SyncDataParallel(mesh)
+    host = [{"x": np.full((8, 3), i, np.float32)} for i in range(5)]
+    windows = list(packed_prefetch(iter(host), strategy, num_steps=2, depth=1))
+    assert [w["x"].shape for w in windows] == [(2, 8, 3), (2, 8, 3)]
+    # contents: window w holds batches 2w and 2w+1, in order
+    np.testing.assert_allclose(np.asarray(windows[1]["x"][1]), host[3]["x"])
+    # the batch (second) dim is sharded over dp
+    assert "dp" in str(windows[0]["x"].sharding.spec)
+
+
 def test_restore_checkpoint_tolerates_missing_model_state(tmp_path):
     """A checkpoint saved WITHOUT model_state (pre-r2 layout) still restores
     into a TrainState target (falls back to a target-less restore)."""
