@@ -1,0 +1,223 @@
+package com.tensorflowonspark.tpu;
+
+import java.io.File;
+import java.io.FileInputStream;
+import java.io.FileOutputStream;
+import java.io.IOException;
+import java.nio.ByteBuffer;
+import java.util.ArrayList;
+import java.util.Arrays;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * JVM-only batch inference: TFRecord shards in, prediction shards out — the
+ * reference's {@code Inference.scala} spark-submit job (reference
+ * Inference.scala:52-79: loadTFRecords → TFModel.transform → write), with
+ * the SavedModelBundle/JNI session replaced by the host-RPC
+ * {@link InferenceClient} (the chips belong to one Python process per TPU
+ * host; see jvm/README.md). No Python runs on THIS side: shards are read
+ * with {@link TFRecordIO}, features decoded with {@link TFExample},
+ * predictions re-encoded as {@code tf.train.Example} records.
+ *
+ * Run standalone per shard directory:
+ *
+ * <pre>
+ *   java com.tensorflowonspark.tpu.BatchInference \
+ *       --server tpu-host:8500 --input /data/shards --output /data/preds \
+ *       --input_mapping x=x --batch_size 128
+ * </pre>
+ *
+ * or call {@link #inferShard} from a Spark {@code mapPartitions} over shard
+ * paths (one {@link InferenceClient} per partition), which is exactly the
+ * reference job's shape.
+ */
+public final class BatchInference {
+
+  private BatchInference() {}
+
+  /** name=name pairs → map (reference inputMapping/outputMapping params). */
+  static Map<String, String> parseMapping(String spec) {
+    Map<String, String> out = new LinkedHashMap<>();
+    if (spec == null || spec.isEmpty()) {
+      return out;
+    }
+    for (String pair : spec.split(",")) {
+      int eq = pair.indexOf('=');
+      if (eq <= 0) {
+        throw new IllegalArgumentException("mapping must be feature=input[,..]: " + pair);
+      }
+      out.put(pair.substring(0, eq).trim(), pair.substring(eq + 1).trim());
+    }
+    return out;
+  }
+
+  /**
+   * Infer one shard: decode Examples, batch the mapped numeric features,
+   * round-trip each batch through the generic binary lane, and write one
+   * output shard of Examples holding the model outputs (row-aligned 1:1
+   * with the input records — the reference's transform contract).
+   * Returns the record count.
+   */
+  public static int inferShard(
+      InferenceClient client, File inShard, File outShard,
+      Map<String, String> inputMapping, int batchSize) throws IOException {
+    List<byte[]> records;
+    try (FileInputStream in = new FileInputStream(inShard)) {
+      records = TFRecordIO.readAll(in, true);
+    }
+    if (records.isEmpty()) {
+      try (FileOutputStream out = new FileOutputStream(outShard)) {
+        TFRecordIO.writeAll(out, List.of());
+      }
+      return 0;
+    }
+    List<byte[]> outRecords = new ArrayList<>(records.size());
+    // mapping fixed ONCE from the shard's first record: per-batch inference
+    // on heterogeneous records would silently change the request shape
+    Map<String, String> mapping =
+        effectiveMapping(TFExample.decode(records.get(0)), inputMapping);
+    for (int start = 0; start < records.size(); start += batchSize) {
+      List<Map<String, Object>> rows = new ArrayList<>();
+      for (int r = start; r < Math.min(start + batchSize, records.size()); r++) {
+        rows.add(TFExample.decode(records.get(r)));
+      }
+      List<InferenceClient.Column> inputs = new ArrayList<>();
+      for (Map.Entry<String, String> m : mapping.entrySet()) {
+        inputs.add(columnFromRows(rows, m.getKey(), m.getValue()));
+      }
+      List<InferenceClient.Column> outputs = client.predictBinaryColumns(inputs);
+      for (int r = 0; r < rows.size(); r++) {
+        Map<String, Object> features = new LinkedHashMap<>();
+        for (InferenceClient.Column col : outputs) {
+          features.put(col.name, rowSlice(col, r, rows.size()));
+        }
+        outRecords.add(TFExample.encode(features));
+      }
+    }
+    try (FileOutputStream out = new FileOutputStream(outShard)) {
+      TFRecordIO.writeAll(out, outRecords);
+    }
+    return records.size();
+  }
+
+  /** Default mapping (reference behavior): every numeric feature feeds an
+   *  input of the same name; bytes features are skipped. */
+  static Map<String, String> effectiveMapping(
+      Map<String, Object> sampleRow, Map<String, String> explicit) {
+    if (!explicit.isEmpty()) {
+      return explicit;
+    }
+    Map<String, String> out = new LinkedHashMap<>();
+    for (Map.Entry<String, Object> e : sampleRow.entrySet()) {
+      if (e.getValue() instanceof long[] || e.getValue() instanceof float[]) {
+        out.put(e.getKey(), e.getKey());
+      }
+    }
+    if (out.isEmpty()) {
+      throw new IllegalArgumentException(
+          "no numeric features to feed; pass --input_mapping");
+    }
+    return out;
+  }
+
+  /** Stack one feature across rows into a [rows, width] wire column. */
+  static InferenceClient.Column columnFromRows(
+      List<Map<String, Object>> rows, String feature, String inputName) throws IOException {
+    Object first = rows.get(0).get(feature);
+    if (first == null) {
+      throw new IOException("feature " + feature + " missing from record");
+    }
+    if (!(first instanceof long[]) && !(first instanceof float[])) {
+      throw new IOException(
+          "feature " + feature + " is a bytes list; only int64/float features "
+              + "can feed the binary lane");
+    }
+    int width = first instanceof long[] ? ((long[]) first).length : ((float[]) first).length;
+    int[] shape = new int[] {rows.size(), width};
+    if (first instanceof long[]) {
+      ByteBuffer b = ByteBuffer.allocate(rows.size() * width * 8)
+          .order(java.nio.ByteOrder.LITTLE_ENDIAN);
+      for (Map<String, Object> row : rows) {
+        long[] v = (long[]) row.get(feature);
+        if (v == null || v.length != width) {
+          throw new IOException("ragged feature " + feature);
+        }
+        for (long x : v) b.putLong(x);
+      }
+      b.flip();
+      return new InferenceClient.Column(inputName, "<i8", shape, b);
+    }
+    ByteBuffer b = ByteBuffer.allocate(rows.size() * width * 4)
+        .order(java.nio.ByteOrder.LITTLE_ENDIAN);
+    for (Map<String, Object> row : rows) {
+      float[] v = (float[]) row.get(feature);
+      if (v == null || v.length != width) {
+        throw new IOException("ragged feature " + feature);
+      }
+      for (float x : v) b.putFloat(x);
+    }
+    b.flip();
+    return new InferenceClient.Column(inputName, "<f4", shape, b);
+  }
+
+  /** Row r of a [rows, ...] output column, as a feature value. */
+  static Object rowSlice(InferenceClient.Column col, int r, int rows) throws IOException {
+    if (col.shape.length == 0 || col.shape[0] != rows) {
+      throw new IOException(
+          "output " + col.name + " is not row-aligned: shape " + Arrays.toString(col.shape));
+    }
+    int per = col.elementCount() / rows;
+    if ("<i4".equals(col.dtype) || "<i8".equals(col.dtype)) {
+      return Arrays.copyOfRange(col.longs(), r * per, (r + 1) * per);
+    }
+    return Arrays.copyOfRange(col.floats(), r * per, (r + 1) * per);
+  }
+
+  public static void main(String[] args) throws Exception {
+    String server = null, input = null, output = null, mapping = null;
+    int batchSize = 128;
+    String usage = "usage: BatchInference --server HOST:PORT --input DIR "
+        + "--output DIR [--input_mapping f=in,...] [--batch_size N]";
+    for (int i = 0; i < args.length; i += 2) {
+      if (i + 1 >= args.length) {
+        System.err.println("missing value for " + args[i] + "\n" + usage);
+        System.exit(2);
+      }
+      switch (args[i]) {
+        case "--server": server = args[i + 1]; break;
+        case "--input": input = args[i + 1]; break;
+        case "--output": output = args[i + 1]; break;
+        case "--input_mapping": mapping = args[i + 1]; break;
+        case "--batch_size": batchSize = Integer.parseInt(args[i + 1]); break;
+        default: throw new IllegalArgumentException("unknown flag " + args[i]);
+      }
+    }
+    int colon = server == null ? -1 : server.lastIndexOf(':');
+    if (server == null || input == null || output == null || colon <= 0) {
+      System.err.println(usage);
+      System.exit(2);
+    }
+    File outDir = new File(output);
+    if (!outDir.isDirectory() && !outDir.mkdirs()) {
+      throw new IOException("cannot create " + outDir);
+    }
+    File[] shards = new File(input).listFiles(
+        (f) -> f.isFile() && !f.getName().startsWith(".") && !f.getName().startsWith("_"));
+    if (shards == null || shards.length == 0) {
+      throw new IOException("no shards under " + input);
+    }
+    Arrays.sort(shards);
+    int total = 0;
+    try (InferenceClient client =
+        new InferenceClient(server.substring(0, colon),
+            Integer.parseInt(server.substring(colon + 1)))) {
+      for (File shard : shards) {
+        total += inferShard(client, shard, new File(outDir, shard.getName()),
+            parseMapping(mapping), batchSize);
+      }
+    }
+    System.out.println("{\"inferred\": " + total + ", \"output\": \"" + output + "\"}");
+  }
+}

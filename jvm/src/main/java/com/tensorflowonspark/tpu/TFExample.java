@@ -166,6 +166,23 @@ public final class TFExample {
   }
 
   /**
+   * Feature name → kind ({@code "int64"} | {@code "float"} | {@code "bytes"})
+   * for one serialized Example — the {@code DFUtil.inferSchema}
+   * (reference DFUtil.scala:67-118) capability: sample a record, build your
+   * Spark StructType from the kinds ({@code long[]}→LongType/ArrayType,
+   * {@code float[]}→FloatType/ArrayType, {@code byte[][]}→BinaryType).
+   */
+  public static Map<String, String> inferSchema(byte[] example) throws IOException {
+    Map<String, String> out = new LinkedHashMap<>();
+    for (Map.Entry<String, Object> e : decode(example).entrySet()) {
+      Object v = e.getValue();
+      out.put(e.getKey(),
+          v instanceof long[] ? "int64" : v instanceof float[] ? "float" : "bytes");
+    }
+    return out;
+  }
+
+  /**
    * Feature map → serialized Example, packed canonical form, names sorted —
    * byte-identical to the Python twin for the same features. Accepted value
    * types: {@code long[]}, {@code int[]}, {@code float[]}, {@code double[]}

@@ -1,0 +1,74 @@
+package com.tensorflowonspark.tpu;
+
+import static org.junit.jupiter.api.Assertions.assertArrayEquals;
+import static org.junit.jupiter.api.Assertions.assertEquals;
+import static org.junit.jupiter.api.Assumptions.assumeTrue;
+
+import java.io.File;
+import java.io.FileInputStream;
+import java.io.FileOutputStream;
+import java.nio.file.Files;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+import org.junit.jupiter.api.Test;
+
+/** The Inference.scala story, JVM-only: shards → live server → shards. */
+class BatchInferenceTest {
+
+  @Test
+  void schemaInference() throws Exception {
+    Map<String, Object> features = new LinkedHashMap<>();
+    features.put("label", new long[] {1});
+    features.put("x", new float[] {0.5f});
+    features.put("raw", new byte[][] {{1}});
+    Map<String, String> schema = TFExample.inferSchema(TFExample.encode(features));
+    assertEquals("int64", schema.get("label"));
+    assertEquals("float", schema.get("x"));
+    assertEquals("bytes", schema.get("raw"));
+  }
+
+  @Test
+  void mappingParser() {
+    Map<String, String> m = BatchInference.parseMapping("a=x, b=y");
+    assertEquals("x", m.get("a"));
+    assertEquals("y", m.get("b"));
+    assertEquals(0, BatchInference.parseMapping(null).size());
+  }
+
+  @Test
+  void endToEndShardsThroughLiveServer() throws Exception {
+    String port = System.getProperty("tos.server.port");
+    assumeTrue(port != null && !port.isEmpty(), "no -Dtos.server.port: live check skipped");
+    File dir = Files.createTempDirectory("tos-batchinfer").toFile();
+    File inShard = new File(dir, "part-00000");
+    // 5 uniform rows of x=[i, 2i]: y = 2i + 6i + 1 = 8i + 1
+    List<byte[]> records = new ArrayList<>();
+    for (int i = 0; i < 5; i++) {
+      Map<String, Object> features = new LinkedHashMap<>();
+      features.put("x", new float[] {i, 2f * i});
+      records.add(TFExample.encode(features));
+    }
+    try (FileOutputStream out = new FileOutputStream(inShard)) {
+      TFRecordIO.writeAll(out, records);
+    }
+    File outShard = new File(dir, "preds-00000");
+    String host = System.getProperty("tos.server.host");
+    try (InferenceClient client = new InferenceClient(
+        host == null || host.isEmpty() ? "127.0.0.1" : host, Integer.parseInt(port))) {
+      int n = BatchInference.inferShard(
+          client, inShard, outShard, BatchInference.parseMapping("x=x"), 2);
+      assertEquals(5, n);
+    }
+    List<byte[]> preds;
+    try (FileInputStream in = new FileInputStream(outShard)) {
+      preds = TFRecordIO.readAll(in, true);
+    }
+    assertEquals(5, preds.size());
+    for (int i = 0; i < 5; i++) {
+      float[] y = (float[]) TFExample.decode(preds.get(i)).get("y_");
+      assertArrayEquals(new float[] {8f * i + 1f}, y, 1e-5f);
+    }
+  }
+}
