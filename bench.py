@@ -637,7 +637,8 @@ def bench_serving(tiny):
     for _ in range(rounds):  # interleaved A/B
         on.append(run_leg(True))
         off.append(run_leg(False))
-    med = lambda legs, k: statistics.median(leg[k] for leg in legs)  # noqa: E731
+    def med(legs, k):
+        return statistics.median(leg[k] for leg in legs)
     for name, legs in (("coalesced", on), ("uncoalesced", off)):
         print(
             "serving {}: {:.0f} rows/s, p50 {:.0f} ms, p99 {:.0f} ms "

@@ -42,24 +42,6 @@ def lr_schedule(args):
     return optax.linear_schedule(0.0, base, warmup)
 
 
-def _prune_checkpoints(model_dir, keep):
-    """Keep the newest ``keep`` ckpt_* dirs (params + momentum add up fast
-    on long runs; only the latest feeds the resume contract). Concurrent
-    pruning by multiple saver processes is harmless — deletions race only
-    against each other, on dirs nobody reads again."""
-    import shutil
-
-    if keep <= 0:
-        return
-    numbered = []
-    for name in os.listdir(model_dir):
-        tail = name.rsplit("_", 1)[-1]
-        if name.startswith("ckpt_") and tail.isdigit():
-            numbered.append((int(tail), name))
-    for _, name in sorted(numbered)[:-keep]:
-        shutil.rmtree(os.path.join(model_dir, name), ignore_errors=True)
-
-
 def main_fun(args, ctx):
     import time
 
@@ -201,7 +183,7 @@ def main_fun(args, ctx):
                 os.path.join(args.model_dir, "ckpt_{}".format(i)), jax.device_get(state)
             )
             last_ckpt = i
-            _prune_checkpoints(args.model_dir, args.keep_checkpoints)
+            checkpoint.prune_checkpoints(args.model_dir, args.keep_checkpoints)
         if i - last_log >= args.log_steps:
             jax.block_until_ready(metrics["loss"])
             dt = time.perf_counter() - t0

@@ -63,6 +63,9 @@ public final class BatchInference {
   public static int inferShard(
       InferenceClient client, File inShard, File outShard,
       Map<String, String> inputMapping, int batchSize) throws IOException {
+    if (batchSize <= 0) {
+      throw new IllegalArgumentException("batchSize must be > 0, got " + batchSize);
+    }
     List<byte[]> records;
     try (FileInputStream in = new FileInputStream(inShard)) {
       records = TFRecordIO.readAll(in, true);
@@ -137,29 +140,27 @@ public final class BatchInference {
     int width = first instanceof long[] ? ((long[]) first).length : ((float[]) first).length;
     int[] shape = new int[] {rows.size(), width};
     if (first instanceof long[]) {
-      ByteBuffer b = ByteBuffer.allocate(rows.size() * width * 8)
-          .order(java.nio.ByteOrder.LITTLE_ENDIAN);
+      long[] flat = new long[rows.size() * width];
+      int i = 0;
       for (Map<String, Object> row : rows) {
         long[] v = (long[]) row.get(feature);
         if (v == null || v.length != width) {
           throw new IOException("ragged feature " + feature);
         }
-        for (long x : v) b.putLong(x);
+        for (long x : v) flat[i++] = x;
       }
-      b.flip();
-      return new InferenceClient.Column(inputName, "<i8", shape, b);
+      return InferenceClient.Column.ofLongs(inputName, shape, flat);
     }
-    ByteBuffer b = ByteBuffer.allocate(rows.size() * width * 4)
-        .order(java.nio.ByteOrder.LITTLE_ENDIAN);
+    float[] flat = new float[rows.size() * width];
+    int i = 0;
     for (Map<String, Object> row : rows) {
       float[] v = (float[]) row.get(feature);
       if (v == null || v.length != width) {
         throw new IOException("ragged feature " + feature);
       }
-      for (float x : v) b.putFloat(x);
+      for (float x : v) flat[i++] = x;
     }
-    b.flip();
-    return new InferenceClient.Column(inputName, "<f4", shape, b);
+    return InferenceClient.Column.ofFloats(inputName, shape, flat);
   }
 
   /** Row r of a [rows, ...] output column, as a feature value. */
@@ -195,10 +196,11 @@ public final class BatchInference {
       }
     }
     int colon = server == null ? -1 : server.lastIndexOf(':');
-    if (server == null || input == null || output == null || colon <= 0) {
+    if (server == null || input == null || output == null || colon <= 0 || batchSize <= 0) {
       System.err.println(usage);
       System.exit(2);
     }
+    Map<String, String> parsedMapping = parseMapping(mapping);  // fail fast, parse once
     File outDir = new File(output);
     if (!outDir.isDirectory() && !outDir.mkdirs()) {
       throw new IOException("cannot create " + outDir);
@@ -215,7 +217,7 @@ public final class BatchInference {
             Integer.parseInt(server.substring(colon + 1)))) {
       for (File shard : shards) {
         total += inferShard(client, shard, new File(outDir, shard.getName()),
-            parseMapping(mapping), batchSize);
+            parsedMapping, batchSize);
       }
     }
     System.out.println("{\"inferred\": " + total + ", \"output\": \"" + output + "\"}");

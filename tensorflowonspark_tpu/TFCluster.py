@@ -21,6 +21,7 @@ import os
 import random
 import secrets
 import threading
+import time as _time
 
 from tensorflowonspark_tpu import TFSparkNode, TFManager, reservation
 
@@ -98,9 +99,6 @@ class TFCluster:
         progress. Findings land in ``tf_status`` (checked by feeders, the
         shutdown join loop, and :meth:`check_errors`).
         """
-        import threading
-        import time as _time
-
         interval = interval or float(os.environ.get("TOS_MONITOR_INTERVAL", "3"))
         stale_secs = stale_secs or float(os.environ.get("TOS_HEARTBEAT_STALE", "30"))
         stop = threading.Event()
@@ -308,7 +306,6 @@ class TFCluster:
                         )
             # poll-join so a watchdog-detected node failure cuts the wait
             # short instead of riding out the full timeout
-            import time as _time
 
             deadline = _time.time() + timeout
             while self.launch_thread.is_alive() and _time.time() < deadline:
@@ -350,8 +347,6 @@ class TFCluster:
         scattered per executor, each posting end-of-feed over its own
         executor-local channel.
         """
-        import time
-
         workers = _worker_rows(self.cluster_info)
         channels = []
         unreachable = []
@@ -368,13 +363,13 @@ class TFCluster:
         if unreachable:
             self._shutdown_by_spark_tasks(grace_secs, unreachable)
         errors = []
-        deadline = time.time() + max(grace_secs, 60)
+        deadline = _time.time() + max(grace_secs, 60)
         for row, mgr in channels:
             while True:
                 status = mgr.get("child_status")
-                if status is not None or time.time() > deadline:
+                if status is not None or _time.time() > deadline:
                     break
-                time.sleep(0.1)
+                _time.sleep(0.1)
             try:
                 eq = mgr.get_queue("error")
                 if not eq.empty():
@@ -431,7 +426,6 @@ class TFCluster:
         on the feed path, reference TFCluster.py:178-183); deterministic
         reclaim + relaunch is the TPU-native recovery story.
         """
-        import time as _time
 
         self.tf_status.setdefault("error", str(reason))
         reached = _abort_nodes(self._current_rows(), self.cluster_meta["authkey"], reason)
@@ -472,7 +466,6 @@ class TFCluster:
         the driver cannot reach AND with a parked ps/evaluator role, neither
         signal can fire — pass ``timeout`` to bound the wait there.
         """
-        import time as _time
 
         deadline = _time.monotonic() + timeout if timeout is not None else None
         mgrs = {}  # keyed by channel address: a task retry re-registers anew

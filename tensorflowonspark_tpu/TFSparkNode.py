@@ -433,16 +433,16 @@ class _NodeLaunchTask:
             # finishes (reference fg-thread dispatch, TFSparkNode.py:391-395)
             child.join()
             mgr.set("state", "stopped")
-            if child.exitcode != 0 and mgr.get("abort") is not None:
-                # the driver's abort watcher killed this child on purpose:
-                # returning (not raising) keeps Spark from retrying the task
-                # against a cluster that is being torn down
-                logger.info(
-                    "node %s:%d terminated by driver abort: %s",
-                    job_name, task_index, mgr.get("abort"),
-                )
-                return []
             if child.exitcode != 0:
+                if mgr.get("abort") is not None:
+                    # the driver's abort watcher killed this child on
+                    # purpose: returning (not raising) keeps Spark from
+                    # retrying the task against a cluster being torn down
+                    logger.info(
+                        "node %s:%d terminated by driver abort: %s",
+                        job_name, task_index, mgr.get("abort"),
+                    )
+                    return []
                 err = None
                 try:
                     eq = mgr.get_queue("error")

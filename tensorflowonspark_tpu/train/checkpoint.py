@@ -103,13 +103,12 @@ def restore_checkpoint(path, target=None):
     return _from_saved(state, target)
 
 
-def latest_checkpoint(model_dir):
-    """Return the newest step-numbered checkpoint dir under ``model_dir``
-    (the reference leaned on ``tf.train.latest_checkpoint``,
-    pipeline.py:541-544)."""
+def _numbered_checkpoints(model_dir):
+    """Sorted [(step, path)] of step-numbered checkpoint dirs under
+    ``model_dir``."""
     model_dir = os.path.abspath(os.path.expanduser(model_dir))
     if not os.path.isdir(model_dir):
-        return None
+        return []
     steps = []
     for name in os.listdir(model_dir):
         sub = os.path.join(model_dir, name)
@@ -117,7 +116,39 @@ def latest_checkpoint(model_dir):
             tail = name.rsplit("_", 1)[-1]
             if tail.isdigit():
                 steps.append((int(tail), sub))
-    return max(steps)[1] if steps else None
+    return sorted(steps)
+
+
+def latest_checkpoint(model_dir):
+    """Return the newest step-numbered checkpoint dir under ``model_dir``
+    (the reference leaned on ``tf.train.latest_checkpoint``,
+    pipeline.py:541-544)."""
+    steps = _numbered_checkpoints(model_dir)
+    return steps[-1][1] if steps else None
+
+
+def prune_checkpoints(model_dir, keep):
+    """Delete all but the newest ``keep`` step-numbered checkpoints (the
+    ``tf.train.CheckpointManager(max_to_keep=...)`` capability: params +
+    optimizer state add up fast on long runs and only the newest feeds the
+    resume contract). Concurrent pruning by multiple saver processes is
+    harmless — deletions race only against each other, on dirs nobody reads
+    again. Returns the number of checkpoints removed."""
+    import shutil
+
+    if keep <= 0:
+        return 0
+    # deletion is gated on the ckpt_ prefix: latest_checkpoint's wider
+    # any-_<digits> match is fine read-only, but rmtree must never touch
+    # sibling numbered dirs the user owns (export versions, run_3, ...)
+    ckpts = [
+        (step, path) for step, path in _numbered_checkpoints(model_dir)
+        if os.path.basename(path).startswith("ckpt_")
+    ]
+    doomed = ckpts[:-keep]
+    for _, path in doomed:
+        shutil.rmtree(path, ignore_errors=True)
+    return len(doomed)
 
 
 def export_saved_model(model_dir, export_dir, state, is_chief=True):

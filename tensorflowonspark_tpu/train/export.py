@@ -161,8 +161,6 @@ def _flatten_dict_tree(tree):
 
 
 def _unflatten_dict_tree(flat):
-    import numpy as np
-
     root = {}
     for path, arr in flat.items():
         if _DTYPE_TAG in path:

@@ -228,3 +228,20 @@ def test_restore_checkpoint_tolerates_missing_model_state(tmp_path):
     np.testing.assert_allclose(
         np.asarray(restored.params["w"]), np.asarray(jax.device_get(state.params["w"]))
     )
+
+
+def test_prune_checkpoints_keeps_newest(tmp_path):
+    import os
+
+    from tensorflowonspark_tpu.train import checkpoint
+
+    for step in (2, 4, 6, 10):
+        (tmp_path / "ckpt_{}".format(step)).mkdir()
+    (tmp_path / "export").mkdir()  # non-numbered dirs are untouched
+    (tmp_path / "run_1").mkdir()  # numbered but NOT ckpt_: deletion must
+    # never touch user-owned siblings (latest_checkpoint may read them)
+    removed = checkpoint.prune_checkpoints(str(tmp_path), keep=2)
+    assert removed == 2
+    assert sorted(os.listdir(tmp_path)) == ["ckpt_10", "ckpt_6", "export", "run_1"]
+    assert checkpoint.latest_checkpoint(str(tmp_path)).endswith("ckpt_10")
+    assert checkpoint.prune_checkpoints(str(tmp_path), keep=0) == 0  # disabled
