@@ -242,6 +242,9 @@ def bench_resnet(tiny, real_data):
             import sys
 
             reps = int(os.environ.get("BENCH_REPS", "1"))
+            budget = float(os.environ.get("BENCH_TIME_BUDGET", "360"))
+            per_dispatch_imgs = (fused if fused > 1 else 1) * batch
+            min_dispatches = 3 if fused > 1 else 8  # bounds prefetch bias
             run_rates, pair_ceilings = [], []
             for _ in range(reps):
                 # bracket each timed block with probes and ratio against
@@ -249,15 +252,32 @@ def bench_resnet(tiny, real_data):
                 # so a probe minutes away (the shape-choice ones) can
                 # describe a different link than the run experienced
                 pre = link_probe()
+                # bound the worst case off the FRESH probe: when the relay
+                # crawls (slow moods run 4x under fast ones), a fixed-size
+                # block can blow past external harness timeouts — shrink it
+                # so all reps' timed blocks fit ~half the time budget
+                d = dispatches
+                max_d = max(
+                    min_dispatches,
+                    int(0.5 * budget * pre / (per_dispatch_imgs * reps)),
+                )
+                if d > max_d:
+                    print(
+                        "link is slow ({:.0f} img/s probed): timed block "
+                        "reduced {} -> {} dispatches to fit the time "
+                        "budget".format(pre / n_chips, d, max_d),
+                        file=sys.stderr,
+                    )
+                    d = max_d
                 t0 = time.perf_counter()
-                for _ in range(dispatches):
+                for _ in range(d):
                     state, metrics = run(state, next(batches))
                 # HOST TRANSFER, not block_until_ready: on relayed/tunneled
                 # TPU runtimes block_until_ready can return at the ack — the
                 # transfer of the last step's loss (which depends on every
                 # prior step) is the only trustworthy fence
                 float(np.asarray(jax.device_get(metrics["loss"])))
-                run_rates.append(images_measured / (time.perf_counter() - t0))
+                run_rates.append(d * per_dispatch_imgs / (time.perf_counter() - t0))
                 post = link_probe()
                 link_rates.extend([pre, post])
                 pair_ceilings.append((pre + post) / 2)
