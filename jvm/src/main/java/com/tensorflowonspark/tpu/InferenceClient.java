@@ -43,6 +43,13 @@ public final class InferenceClient implements Closeable {
    *  constructor once the model is warm. */
   public static final int DEFAULT_TIMEOUT_MILLIS = 600_000;
 
+  /** Hard cap on one binary frame (request column payloads and replies).
+   *  NOTE: the Python server enforces its own limit, TOS_SERVING_MAX_FRAME
+   *  (default 512 MiB) — a column passing this client gate can still be
+   *  refused server-side; this constant only bounds what the client is
+   *  willing to build or accept. */
+  public static final int MAX_FRAME = 1 << 30;
+
   private final Socket socket;
   private final DataInputStream in;
   private final DataOutputStream out;
@@ -186,14 +193,45 @@ public final class InferenceClient implements Closeable {
       return new Column(name, "<i8", shape, b);
     }
 
-    public int elementCount() {
-      int n = 1;
-      for (int d : shape) n *= d;
+    /** Element count in long arithmetic; rejects negative dims/overflow. */
+    public long elementCountLong() {
+      long n = 1;
+      for (int d : shape) {
+        if (d < 0) throw new IllegalArgumentException("column " + name + ": negative dim " + d);
+        try {
+          n = Math.multiplyExact(n, (long) d);
+        } catch (ArithmeticException e) {
+          throw new IllegalArgumentException("column " + name + ": shape overflows long");
+        }
+      }
       return n;
     }
 
+    public int elementCount() {
+      long n = elementCountLong();
+      if (n > Integer.MAX_VALUE) {
+        throw new IllegalArgumentException("column " + name + ": " + n + " elements exceed int range");
+      }
+      return (int) n;
+    }
+
+    /**
+     * Sized in long arithmetic and gated on the 1&lt;&lt;30 frame limit BEFORE
+     * narrowing to int: a column near/above 2 GiB must be rejected here, not
+     * silently wrapped into a mis-sized buffer.
+     */
     public int byteSize() {
-      return elementCount() * Integer.parseInt(dtype.substring(2));
+      long n;
+      try {
+        n = Math.multiplyExact(elementCountLong(), (long) Integer.parseInt(dtype.substring(2)));
+      } catch (ArithmeticException e) {
+        throw new IllegalArgumentException("column " + name + ": byte size overflows long");
+      }
+      if (n > MAX_FRAME) {
+        throw new IllegalArgumentException(
+            "column " + name + ": " + n + " bytes exceeds the frame limit " + MAX_FRAME);
+      }
+      return (int) n;
     }
 
     public float[] floats() {
@@ -222,6 +260,20 @@ public final class InferenceClient implements Closeable {
     // validate BEFORE writing anything: a mismatch detected mid-send would
     // leave the persistent connection desynchronized for every later call
     for (Column c : inputs) {
+      // names land verbatim inside the JSON header; a quote/backslash/control
+      // char would desynchronize the connection (BatchInference derives input
+      // names from TFRecord feature names, which are data-controlled)
+      for (int i = 0; i < c.name.length(); i++) {
+        char ch = c.name.charAt(i);
+        if (ch == '"' || ch == '\\' || ch < 0x20) {
+          throw new IllegalArgumentException(
+              "column name " + c.name + " contains a character unsafe for the JSON header");
+        }
+      }
+      if (!("<f4".equals(c.dtype) || "<f8".equals(c.dtype)
+          || "<i4".equals(c.dtype) || "<i8".equals(c.dtype))) {
+        throw new IllegalArgumentException("column " + c.name + ": unsupported dtype " + c.dtype);
+      }
       if (c.data.remaining() != c.byteSize()) {
         throw new IllegalArgumentException(
             "column " + c.name + ": buffer holds " + c.data.remaining()
@@ -229,8 +281,8 @@ public final class InferenceClient implements Closeable {
       }
     }
     StringBuilder header = new StringBuilder("{\"type\": \"predict_binary\", \"columns\": [");
-    int total = 0;
-    for (int i = 0; i < inputs.size(); i++) {
+    long total = 0;  // long + aggregate gate: per-column checks alone would
+    for (int i = 0; i < inputs.size(); i++) {  // let the SUM wrap an int
       Column c = inputs.get(i);
       if (i > 0) header.append(", ");
       header.append("{\"name\": \"").append(c.name)
@@ -242,11 +294,15 @@ public final class InferenceClient implements Closeable {
       header.append("]}");
       total += c.byteSize();
     }
+    if (total > MAX_FRAME) {
+      throw new IllegalArgumentException(
+          "columns total " + total + " bytes, exceeding the frame limit " + MAX_FRAME);
+    }
     header.append("]}");
     byte[] hb = header.toString().getBytes(StandardCharsets.UTF_8);
     out.writeInt(hb.length);
     out.write(hb);
-    out.writeInt(total);
+    out.writeInt((int) total);
     for (Column c : inputs) {
       ByteBuffer b = c.data.duplicate();
       byte[] chunk = new byte[c.byteSize()];
@@ -298,7 +354,7 @@ public final class InferenceClient implements Closeable {
     if ("error".equals(type)) throw new IOException("server error: " + text);
     if (!"result_binary".equals(type)) throw new IOException("unexpected reply: " + text);
     int blen = in.readInt();
-    if (blen < 0 || blen > (1 << 30)) throw new IOException("bad binary frame length " + blen);
+    if (blen < 0 || blen > MAX_FRAME) throw new IOException("bad binary frame length " + blen);
     byte[] raw = new byte[blen];
     in.readFully(raw);
     return new BinaryReply(text, raw);

@@ -107,4 +107,36 @@ class InferenceClientTest {
       }
     }
   }
+
+  @Test
+  void columnSizesComputedInLongAndGated() {
+    // near/above 2 GiB used to overflow int before the frame check could
+    // catch it (ADVICE r4); sizes are now long and gated on the 1 GiB limit
+    InferenceClient.Column big = new InferenceClient.Column(
+        "big", "<f8", new int[] {1 << 30, 4}, java.nio.ByteBuffer.allocate(0));
+    assertThrows(IllegalArgumentException.class, big::byteSize);
+    InferenceClient.Column neg = new InferenceClient.Column(
+        "neg", "<f4", new int[] {-1, 4}, java.nio.ByteBuffer.allocate(0));
+    assertThrows(IllegalArgumentException.class, neg::elementCount);
+    InferenceClient.Column ok = InferenceClient.Column.ofFloats(
+        "ok", new int[] {2, 2}, new float[] {1, 2, 3, 4});
+    assertEquals(16, ok.byteSize());
+  }
+
+  @Test
+  void unsafeColumnNameRejectedBeforeSend() throws Exception {
+    // a quote in a column name (data-controlled via TFRecord feature names in
+    // BatchInference) would desynchronize the JSON header framing — it must
+    // be rejected client-side BEFORE any bytes hit the wire, leaving the
+    // persistent connection usable
+    try (InferenceClient c = client()) {
+      InferenceClient.Column bad =
+          InferenceClient.Column.ofFloats("x\"evil", new int[] {1, 1}, new float[] {1f});
+      assertThrows(
+          IllegalArgumentException.class,
+          () -> c.predictBinaryColumns(java.util.Collections.singletonList(bad)));
+      float[][] out = c.predictBinary("x", new float[][] {{1f, 1f}});
+      assertEquals(2f + 3f + 1f, out[0][0], 1e-5f);
+    }
+  }
 }

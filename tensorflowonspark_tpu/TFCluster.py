@@ -515,11 +515,12 @@ def run_with_recovery(
     poll_secs=1.0,
     shutdown_timeout=600,
     completion_timeout=None,
+    feed_fn=None,
     **run_kwargs,
 ):
     """Train with automatic failure recovery: run → detect (watchdog / launch
-    error) → :meth:`TFCluster.abort` the survivors → relaunch → ``map_fun``
-    resumes from its latest checkpoint.
+    error / failed feed) → :meth:`TFCluster.abort` the survivors → relaunch →
+    ``map_fun`` resumes from its latest checkpoint.
 
     The reference stopped at *detection* — on a node error the feed path
     raised and the docs told the operator to resubmit the job (reference
@@ -530,10 +531,19 @@ def run_with_recovery(
     contract proven end-to-end in ``tests/test_resume.py`` — and this helper
     supplies detection, deterministic teardown, and relaunch around it.
 
-    ``InputMode.TENSORFLOW`` only (the perf path: nodes read their own data).
-    In SPARK mode the driver is mid-``train()`` when a node dies and the feed
-    RDD's lineage/position belongs to the caller — recovery there means
-    re-running the caller's feed loop, which only the caller can do.
+    Two input modes:
+
+    * ``InputMode.TENSORFLOW`` (the perf path: nodes read their own data) —
+      leave ``feed_fn`` unset; each attempt waits for worker completion.
+    * ``InputMode.SPARK`` — pass ``feed_fn(cluster)``, the caller's feed
+      loop (``cluster.train(...)`` calls). The feed RDD's lineage belongs to
+      the caller, so only the caller can re-feed: on a node death mid-feed
+      the feed task raises (feed timeout / watchdog), the attempt is
+      aborted, and ``feed_fn`` is re-invoked FROM THE START against the
+      relaunched cluster — ``map_fun`` resumes from its checkpoint and
+      trains on the re-fed stream (use closure state inside ``feed_fn`` for
+      partial re-feeds). After ``feed_fn`` returns, ``check_errors()``
+      catches failures that raced the feed's completion.
 
     ``completion_timeout`` bounds each attempt's completion wait for the one
     topology where no completion signal can reach the driver (NAT'd worker
@@ -546,11 +556,13 @@ def run_with_recovery(
     Returns the number of relaunches performed (0 = clean first run).
     """
     mode = run_kwargs.get("input_mode", InputMode.SPARK)
-    if mode != InputMode.TENSORFLOW:
+    if mode != InputMode.TENSORFLOW and feed_fn is None:
         raise ValueError(
-            "run_with_recovery requires input_mode=InputMode.TENSORFLOW; in SPARK "
-            "mode re-feed from the caller's loop after cluster.check_errors() raises"
+            "run_with_recovery in SPARK mode needs feed_fn=<your feed loop>; "
+            "without a feed, use input_mode=InputMode.TENSORFLOW"
         )
+    if mode == InputMode.TENSORFLOW and feed_fn is not None:
+        raise ValueError("feed_fn requires input_mode=InputMode.SPARK")
     attempt = 0
     while True:
         failure = None
@@ -560,12 +572,20 @@ def run_with_recovery(
         except Exception as e:
             failure = e
         if cluster is not None:
-            # wait for training to finish, cutting out early on a detected
-            # node failure (watchdog error-queue peek / heartbeat loss);
-            # NOT a launch-thread join — ps/evaluator tasks park until
-            # shutdown, so the launch job outlives training by design
-            cluster.wait_for_completion(poll_secs, timeout=completion_timeout)
             try:
+                if feed_fn is not None:
+                    # SPARK mode: drive the caller's feed; a dead node
+                    # surfaces as a feed-task exception (queue timeout) or
+                    # as a watchdog error raced past the feed's return
+                    feed_fn(cluster)
+                    cluster.check_errors()
+                else:
+                    # wait for training to finish, cutting out early on a
+                    # detected node failure (watchdog error-queue peek /
+                    # heartbeat loss); NOT a launch-thread join — ps/
+                    # evaluator tasks park until shutdown, so the launch
+                    # job outlives training by design
+                    cluster.wait_for_completion(poll_secs, timeout=completion_timeout)
                 cluster.shutdown(timeout=shutdown_timeout)
                 return attempt
             except Exception as e:

@@ -20,20 +20,42 @@ from flax import linen as nn
 from tensorflowonspark_tpu.models import register
 
 
+def _norm_factory(bn_impl, train, dtype):
+    """BatchNorm constructor for ``bn_impl``: ``"flax"`` = ``nn.BatchNorm``
+    (global sync-BN under pjit), ``"pallas"`` = the fused-kernel
+    :class:`~tensorflowonspark_tpu.ops.fused_bn.FusedBatchNorm` (per-shard
+    stats — the r5 BN-slice experiment, docs/perf.md)."""
+    if bn_impl == "pallas":
+        import jax
+
+        from tensorflowonspark_tpu.ops.fused_bn import FusedBatchNorm
+
+        # same convention as the transformer's flash attention: interpret
+        # (CPU emulation) everywhere but real TPU
+        cls = functools.partial(
+            FusedBatchNorm, interpret=jax.default_backend() != "tpu"
+        )
+    elif bn_impl == "flax":
+        cls = nn.BatchNorm
+    else:
+        raise ValueError("bn_impl must be 'flax' or 'pallas', got {!r}".format(bn_impl))
+    return functools.partial(
+        cls, use_running_average=not train, momentum=0.9, epsilon=1e-5, dtype=dtype
+    )
+
+
 class BottleneckBlock(nn.Module):
     """ResNet v1.5 bottleneck: 1x1 → 3x3(stride) → 1x1, projection shortcut."""
 
     filters: int
     strides: int = 1
     dtype: jnp.dtype = jnp.float32
+    bn_impl: str = "flax"
 
     @nn.compact
     def __call__(self, x, train=False):
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = functools.partial(
-            nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype,
-        )
+        norm = _norm_factory(self.bn_impl, train, self.dtype)
         shortcut = x
         if x.shape[-1] != self.filters * 4 or self.strides != 1:
             shortcut = conv(self.filters * 4, (1, 1), strides=self.strides, name="proj")(x)
@@ -54,14 +76,12 @@ class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
     dtype: jnp.dtype = jnp.float32
+    bn_impl: str = "flax"
 
     @nn.compact
     def __call__(self, x, train=False):
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = functools.partial(
-            nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype,
-        )
+        norm = _norm_factory(self.bn_impl, train, self.dtype)
         shortcut = x
         if x.shape[-1] != self.filters or self.strides != 1:
             shortcut = conv(self.filters, (1, 1), strides=self.strides, name="proj")(x)
@@ -82,13 +102,13 @@ class ResNet(nn.Module):
     bottleneck: bool = True
     stem: str = "imagenet"  # 7x7/2 + maxpool, "imagenet_s2d", or "cifar" 3x3
     dtype: jnp.dtype = jnp.float32
+    bn_impl: str = "flax"
 
     @nn.compact
     def __call__(self, x, train=False):
         conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
         stem_bn = functools.partial(
-            nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype, name="stem_bn",
+            _norm_factory(self.bn_impl, train, self.dtype), name="stem_bn"
         )
         x = x.astype(self.dtype)
         if self.stem == "imagenet":
@@ -127,6 +147,7 @@ class ResNet(nn.Module):
                 strides = 2 if (i == 0 and stage > 0) else 1
                 x = block_cls(
                     filters, strides=strides, dtype=self.dtype,
+                    bn_impl=self.bn_impl,
                     name="stage{}_block{}".format(stage, i),
                 )(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
@@ -136,13 +157,15 @@ class ResNet(nn.Module):
 
 
 @register("resnet50")
-def resnet50(num_classes=1000, dtype=jnp.float32, stem="imagenet"):
+def resnet50(num_classes=1000, dtype=jnp.float32, stem="imagenet", bn_impl="flax"):
     """ResNet-50 v1.5 (reference resnet_model.py layer spec [3,4,6,3]).
     ``stem="imagenet_s2d"`` opts into the space-to-depth stem (TPU MXU
-    occupancy — see ResNet.__call__)."""
+    occupancy — see ResNet.__call__); ``bn_impl="pallas"`` into the fused
+    BatchNorm kernels (per-shard stats — docs/perf.md r5)."""
     return ResNet(
         stage_sizes=(3, 4, 6, 3), filters=(64, 128, 256, 512),
         num_classes=num_classes, bottleneck=True, stem=stem, dtype=dtype,
+        bn_impl=bn_impl,
     )
 
 

@@ -14,15 +14,28 @@ predict-fn builder) rather than a TF SavedModel; ``protocol`` selects
 ICI/DCN behavior rather than grpc/RDMA; inference executors run the bundle on
 whatever platform they have (CPU executors included).
 
-Works against real ``pyspark.ml`` pipelines when pyspark is installed (the
-classes duck-type Estimator/Model) and against the local backend's
-``LocalDataFrame`` otherwise.
+When pyspark is installed, :class:`TFEstimator`/:class:`TFModel` subclass
+``pyspark.ml.Estimator``/``pyspark.ml.Model`` (the reference subclassed them
+too, pipeline.py:349,433), so they pass ``pyspark.ml.Pipeline``'s isinstance
+checks and sit in real ML pipelines. Without pyspark the bases degrade to
+``object`` and everything runs against the local backend's ``LocalDataFrame``.
 """
 
 import argparse
 import logging
 
 logger = logging.getLogger(__name__)
+
+try:  # real pyspark.ml citizenship when pyspark is importable
+    from pyspark.ml import Estimator as _MLEstimatorBase
+    from pyspark.ml import Model as _MLModelBase
+except Exception:  # local backend: no pyspark dependency
+
+    class _MLEstimatorBase:
+        pass
+
+    class _MLModelBase:
+        pass
 
 
 # -- param plumbing (pyspark.ml.param.Param equivalent) ------------------------
@@ -46,13 +59,22 @@ class Param:
 
 
 class Params:
-    """Minimal pyspark.ml.param.Params: typed params with defaults + setters."""
+    """Minimal pyspark.ml.param.Params: typed params with defaults + setters.
+
+    When the pyspark bases are live, their ``Params``/``Identifiable`` chain
+    runs first (sets ``uid`` and pyspark's own empty maps) and then this
+    class installs its string-keyed maps; the accessors defined here shadow
+    pyspark's Param-object-keyed machinery throughout (``_param_index`` is
+    deliberately not named ``_params`` — pyspark's ``Params.__init__`` sets
+    an instance attribute of that name which would shadow a method).
+    """
 
     def __init__(self):
+        super().__init__()
         self._paramMap = {}
         self._defaultParamMap = {}
 
-    def _params(self):
+    def _param_index(self):
         out = {}
         for klass in type(self).__mro__:
             for name, val in vars(klass).items():
@@ -61,7 +83,7 @@ class Params:
         return out
 
     def _set(self, **kwargs):
-        params = self._params()
+        params = self._param_index()
         for name, value in kwargs.items():
             if name not in params:
                 raise ValueError("unknown param {!r}".format(name))
@@ -419,8 +441,9 @@ class TFParams(Params):
 class TFEstimator(TFParams, HasBatchSize, HasClusterSize, HasEpochs, HasGraceSecs,
                   HasInputMapping, HasInputMode, HasMasterNode, HasModelDir, HasNumPS,
                   HasProtocol, HasReaders, HasSteps, HasTensorboard, HasTFRecordDir,
-                  HasExportDir):
-    """Spark-ML-style Estimator: ``fit(df)`` trains ``train_fn`` on a cluster
+                  HasExportDir, _MLEstimatorBase):
+    """Spark-ML Estimator (a real ``pyspark.ml.Estimator`` subclass when
+    pyspark is installed): ``fit(df)`` trains ``train_fn`` on a cluster
     fed from the DataFrame and returns a :class:`TFModel`
     (reference pipeline.py:351-432).
 
@@ -442,7 +465,20 @@ class TFEstimator(TFParams, HasBatchSize, HasClusterSize, HasEpochs, HasGraceSec
         self.jax_distributed = jax_distributed
         self.args = Namespace(tf_args) if tf_args is not None else Namespace({})
 
-    def fit(self, dataset):
+    def fit(self, dataset, params=None):
+        # pyspark's Estimator.fit(params=dict) copies the stage; here extra
+        # params are applied in place (this estimator's maps are string-keyed)
+        if isinstance(params, (list, tuple)):
+            # pyspark's list-of-param-maps form (CrossValidator et al.) wants
+            # one trained model per map — each map here is a full cluster
+            # run; refuse clearly rather than AttributeError on .items()
+            raise NotImplementedError(
+                "TFEstimator.fit does not support a list of param maps; fit "
+                "once per configuration (each fit is a full cluster run)"
+            )
+        if params:
+            self._set(**{(k.name if isinstance(k, Param) else k): v
+                         for k, v in params.items()})
         return self._fit(dataset)
 
     def _fit(self, dataset):
@@ -507,16 +543,20 @@ class TFEstimator(TFParams, HasBatchSize, HasClusterSize, HasEpochs, HasGraceSec
 
 
 class TFModel(TFParams, HasBatchSize, HasInputMapping, HasOutputMapping, HasModelDir,
-              HasExportDir, HasSignatureDefKey, HasTagSet):
-    """Spark-ML-style Model: ``transform(df)`` runs batch inference from the
-    exported bundle in each executor's python worker, no cluster needed
+              HasExportDir, HasSignatureDefKey, HasTagSet, _MLModelBase):
+    """Spark-ML Model (a real ``pyspark.ml.Model``/``Transformer`` subclass
+    when pyspark is installed): ``transform(df)`` runs batch inference from
+    the exported bundle in each executor's python worker, no cluster needed
     (reference pipeline.py:435-644)."""
 
     def __init__(self, tf_args=None):
         super().__init__()
         self.args = Namespace(tf_args) if tf_args is not None else Namespace({})
 
-    def transform(self, dataset):
+    def transform(self, dataset, params=None):
+        if params:
+            self._set(**{(k.name if isinstance(k, Param) else k): v
+                         for k, v in params.items()})
         return self._transform(dataset)
 
     def _transform(self, dataset):
@@ -546,7 +586,9 @@ def _build_dataframe(source_df, rows, output_cols):
         from tensorflowonspark_tpu.backends.local import LocalDataFrame
 
         return LocalDataFrame(rdd, output_cols)
-    spark = source_df.sql_ctx if hasattr(source_df, "sql_ctx") else None
+    # df.sparkSession is the Spark>=3.3 surface; sql_ctx was removed in
+    # Spark 4 (kept as the fallback for older pyspark)
+    spark = getattr(source_df, "sparkSession", None) or getattr(source_df, "sql_ctx", None)
     if spark is not None:
         return spark.createDataFrame(rdd, output_cols)
     return rdd

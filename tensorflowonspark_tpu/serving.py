@@ -97,6 +97,14 @@ def _arrays_to_columns(arrays):
     return columns, b"".join(parts)
 
 
+class Overloaded(RuntimeError):
+    """Request shed because the predictor's pending queue is full."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """Request shed because it waited in queue past its deadline."""
+
+
 class _Predictor:
     """Single predictor thread owning the chips: requests queue up, and
     same-signature requests that are waiting together coalesce into ONE
@@ -106,16 +114,32 @@ class _Predictor:
     A signature is (sorted column names, per-column dtype + trailing shape);
     only axis-0 (batch) concatenation is ever performed, so results are
     bit-identical to individual runs for row-wise models.
+
+    Tail-latency policy (VERDICT r4): the pending queue is BOUNDED
+    (``max_pending`` requests, default ``TOS_SERVING_MAX_PENDING`` = 256) —
+    a full queue sheds new requests with :class:`Overloaded` instead of
+    growing an unbounded backlog behind a slow model; and each request may
+    carry a deadline (``deadline_ms``, default ``TOS_SERVING_DEADLINE_MS``,
+    0 = off) — a request still queued when its deadline passes is failed
+    with :class:`DeadlineExceeded` rather than served arbitrarily late.
+    Both surface to clients as the protocol's error reply.
     """
 
-    def __init__(self, predict_fn, params, model_state, max_rows=None):
+    def __init__(self, predict_fn, params, model_state, max_rows=None,
+                 max_pending=None, deadline_ms=None):
         import collections
 
         self._predict_fn = predict_fn
         self._params = params
         self._model_state = model_state
         self._max_rows = max_rows or int(os.environ.get("TOS_SERVING_COALESCE_ROWS", "1024"))
-        self._q = queue.Queue()
+        self._max_pending = max_pending or int(os.environ.get("TOS_SERVING_MAX_PENDING", "256"))
+        self._deadline_secs = (
+            deadline_ms if deadline_ms is not None
+            else int(os.environ.get("TOS_SERVING_DEADLINE_MS", "0"))
+        ) / 1000.0
+        # +1 slot so stop()'s sentinel can always enqueue behind a full load
+        self._q = queue.Queue(maxsize=self._max_pending + 1)
         self._stop = object()
         #: deferred non-matching requests, served FIRST next cycle — keeps
         #: FIFO so a minority-signature request can't be starved by sustained
@@ -131,7 +155,10 @@ class _Predictor:
 
         Rejects malformed requests HERE (0-d arrays, mismatched leading
         dims, empty input dict) so a bad request becomes the caller's error
-        reply, never a predictor-thread crash."""
+        reply, never a predictor-thread crash. Sheds with
+        :class:`Overloaded` when ``max_pending`` requests are queued."""
+        import time as _time
+
         import numpy as np
         from concurrent.futures import Future
 
@@ -149,6 +176,9 @@ class _Predictor:
         if len(lead) != 1:
             raise ValueError("input columns disagree on row count: {}".format(sorted(lead)))
 
+        deadline = (
+            _time.monotonic() + self._deadline_secs if self._deadline_secs > 0 else None
+        )
         fut = Future()
         # the lock orders every put against stop()'s sentinel: a submit that
         # wins the race enqueues BEFORE the sentinel (the run thread serves
@@ -156,7 +186,18 @@ class _Predictor:
         with self._submit_lock:
             if self._stopped:
                 raise RuntimeError("predictor stopped")
-            self._q.put((arrays, fut))
+            # count the BACKLOG too: deferred requests (signature mismatch /
+            # rows-cap overshoot) leave the queue but are still pending, and
+            # a slow model can park the entire load there — a qsize()-only
+            # gate would never fire. Both reads are exact enough under the
+            # lock (the only other mutator is the single consumer thread).
+            if self._q.qsize() + len(self._backlog) >= self._max_pending:
+                raise Overloaded(
+                    "server overloaded: {} requests pending; request shed".format(
+                        self._max_pending
+                    )
+                )
+            self._q.put((arrays, fut, deadline))
         return fut.result()
 
     def stop(self):
@@ -193,6 +234,21 @@ class _Predictor:
             for name in sorted(arrays)
         )
 
+    def _expired(self, item):
+        """Fail a queued request whose deadline passed; True if it was."""
+        import time as _time
+
+        if item[2] is not None and _time.monotonic() > item[2]:
+            item[1].set_exception(
+                DeadlineExceeded(
+                    "request shed: queued past its {:.0f} ms deadline".format(
+                        self._deadline_secs * 1000
+                    )
+                )
+            )
+            return True
+        return False
+
     def _run(self):
         import numpy as np
 
@@ -204,6 +260,8 @@ class _Predictor:
                     pending[1].set_exception(RuntimeError("predictor stopped"))
                 self._backlog.clear()
                 return
+            if self._expired(item):
+                continue
             batch = [item]
             try:
                 sig = self._signature(item[0])
@@ -215,8 +273,27 @@ class _Predictor:
             # then whatever is already waiting on the queue. Non-matching
             # requests keep FIFO order in the backlog, whose head seeds the
             # next cycle — mixed-signature load batches per signature instead
-            # of degrading to one request per dispatch.
+            # of degrading to one request per dispatch. A request that would
+            # push the batch past max_rows is DEFERRED, not appended
+            # (ADVICE r4): the dispatch shape stays within the operator's
+            # bound, so the power-of-two padding below keeps its shape-reuse
+            # guarantee under sustained load.
             deferred = []
+
+            def _admit(nxt):
+                """Coalesce nxt into the batch, defer it, or expire it —
+                one admission policy shared by both scan loops below."""
+                nonlocal rows
+                if self._expired(nxt):
+                    return
+                if nxt[0] and self._signature(nxt[0]) == sig:
+                    nxt_rows = next(iter(nxt[0].values())).shape[0]
+                    if rows + nxt_rows <= self._max_rows:
+                        batch.append(nxt)
+                        rows += nxt_rows
+                        return
+                deferred.append(nxt)
+
             saw_stop = False
             while self._backlog and rows < self._max_rows:
                 nxt = self._backlog.popleft()
@@ -224,11 +301,7 @@ class _Predictor:
                     deferred.append(nxt)
                     saw_stop = True
                     break
-                if self._signature(nxt[0]) == sig:
-                    batch.append(nxt)
-                    rows += next(iter(nxt[0].values())).shape[0]
-                else:
-                    deferred.append(nxt)
+                _admit(nxt)
             while not saw_stop and rows < self._max_rows:
                 try:
                     nxt = self._q.get_nowait()
@@ -237,11 +310,7 @@ class _Predictor:
                 if nxt is self._stop:
                     deferred.append(nxt)
                     break
-                if self._signature(nxt[0]) == sig and nxt[0]:
-                    batch.append(nxt)
-                    rows += next(iter(nxt[0].values())).shape[0]
-                else:
-                    deferred.append(nxt)
+                _admit(nxt)
             # deferred items are older than anything left in the backlog
             self._backlog.extendleft(reversed(deferred))
 
@@ -260,10 +329,10 @@ class _Predictor:
                     # to speed up. Single requests keep their exact shape —
                     # the client's batch size is the client's contract.
                     # Row-wise semantics make the padding rows inert; the
-                    # per-request split below never reads them.
-                    # capped at the operator's row limit: the coalesce loop
-                    # can overshoot _max_rows by one request, and padding
-                    # must not double that into an even bigger dispatch
+                    # per-request split below never reads them. Coalesced
+                    # rows never exceed _max_rows (overshooters are
+                    # deferred above), so the cap only canonicalizes the
+                    # top bucket when _max_rows is not a power of two.
                     bucket = min(1 << (rows - 1).bit_length(), self._max_rows)
                     if bucket > rows:
                         arrays = {
@@ -277,14 +346,14 @@ class _Predictor:
                     outputs = {"output": outputs}
                 outputs = {name: np.asarray(v) for name, v in outputs.items()}
             except Exception as e:
-                for _arrays, fut in batch:
+                for _arrays, fut, _deadline in batch:
                     fut.set_exception(e)
                 continue
             if len(batch) == 1:
                 batch[0][1].set_result(outputs)
             else:
                 start = 0
-                for req_arrays, fut in batch:
+                for req_arrays, fut, _deadline in batch:
                     n = next(iter(req_arrays.values())).shape[0]
                     fut.set_result(
                         {name: v[start : start + n] for name, v in outputs.items()}
@@ -424,6 +493,11 @@ class InferenceServer:
             arrays = _columns_to_arrays(msg.get("columns") or [], payload)
             outputs = self._predictor.submit(arrays)
             columns, out_payload = _arrays_to_columns(outputs)
+        except (Overloaded, DeadlineExceeded) as e:
+            # expected under load-shedding policy: no traceback spam
+            logger.warning("binary predict shed: %s", e)
+            msock.send({"type": "error", "message": "{}: {}".format(type(e).__name__, e)})
+            return
         except Exception as e:
             logger.exception("binary predict failed")
             msock.send({"type": "error", "message": "{}: {}".format(type(e).__name__, e)})
@@ -440,6 +514,9 @@ class InferenceServer:
         if kind == "predict":
             try:
                 return {"type": "result", "outputs": self._predict(msg.get("inputs") or {})}
+            except (Overloaded, DeadlineExceeded) as e:
+                logger.warning("predict shed: %s", e)
+                return {"type": "error", "message": "{}: {}".format(type(e).__name__, e)}
             except Exception as e:
                 logger.exception("predict failed")
                 return {"type": "error", "message": "{}: {}".format(type(e).__name__, e)}

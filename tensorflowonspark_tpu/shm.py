@@ -163,14 +163,17 @@ class ShmChunk(Marker):
             ]
         finally:
             seg.close()
+            # attach registered the segment with this process's tracker
+            # (CPython pre-3.13 registers on attach too) and unlink()
+            # UNREGISTERS it again — sending our own extra unregister after
+            # that made the tracker's cache.remove() raise the KeyError
+            # tracebacks seen in every dryrun log (MULTICHIP_r04 tail).
+            # Only the unlink-already-gone path still needs the manual
+            # unregister, to balance the attach-side registration.
             try:
                 seg.unlink()
             except FileNotFoundError:
-                pass
-            # pre-3.13 CPython registers attach-side segments with the
-            # resource_tracker too; drop the registration so the consumer's
-            # tracker doesn't warn + double-unlink at exit
-            _unregister_from_tracker(self.name)
+                _unregister_from_tracker(self.name)
         return out
 
     def rows(self):
@@ -198,16 +201,23 @@ class ShmChunk(Marker):
         return list(zip(*cols))
 
     def discard(self):
-        """Unlink without reading (drain paths)."""
+        """Unlink without reading (drain paths). unlink() already
+        unregisters from this process's tracker — see materialize()."""
         from multiprocessing import shared_memory
 
         try:
             seg = shared_memory.SharedMemory(name=self.name)
-            seg.close()
-            seg.unlink()
-            _unregister_from_tracker(self.name)
         except FileNotFoundError:
-            pass
+            return
+        except Exception:
+            logger.warning("failed to discard shm chunk %s", self.name, exc_info=True)
+            return
+        seg.close()
+        try:
+            seg.unlink()
+        except FileNotFoundError:
+            # lost an unlink race: balance the attach-side registration
+            _unregister_from_tracker(self.name)
         except Exception:
             logger.warning("failed to discard shm chunk %s", self.name, exc_info=True)
 

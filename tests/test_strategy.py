@@ -244,4 +244,10 @@ def test_prune_checkpoints_keeps_newest(tmp_path):
     assert removed == 2
     assert sorted(os.listdir(tmp_path)) == ["ckpt_10", "ckpt_6", "export", "run_1"]
     assert checkpoint.latest_checkpoint(str(tmp_path)).endswith("ckpt_10")
+    # a user-owned numbered sibling sorting above every ckpt_ dir must not
+    # be returned as the resume point (ADVICE r4: it would break the
+    # run_with_recovery resume contract)
+    (tmp_path / "run_99").mkdir()
+    assert checkpoint.latest_checkpoint(str(tmp_path)).endswith("ckpt_10")
+    assert checkpoint.latest_checkpoint(str(tmp_path), prefix="").endswith("run_99")
     assert checkpoint.prune_checkpoints(str(tmp_path), keep=0) == 0  # disabled
