@@ -55,29 +55,37 @@ MODULES = [
     "tensorflowonspark_tpu.models.segmentation",
     "tensorflowonspark_tpu.models.transformer",
     "tensorflowonspark_tpu.ops.flash_attention",
+    "tensorflowonspark_tpu.ops.fused_bn",
     "tensorflowonspark_tpu.backends.local",
 ]
 
 
-def _signature(obj):
+def _strip_addresses(text):
+    """Default-value / docstring reprs with memory addresses are
+    run-dependent; docs must be deterministic for the CI freshness check."""
     import re
 
+    text = re.sub(r"<([\w.]+) object at 0x[0-9a-f]+>", r"<\1>", text)
+    return re.sub(r"<(function|built-in function) ([\w.<>]+) at 0x[0-9a-f]+>", r"<\1 \2>", text)
+
+
+def _signature(obj):
     try:
         sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         return "(...)"
-    # default-value reprs with memory addresses are run-dependent; docs must
-    # be deterministic for the CI freshness check
-    return re.sub(r"<([\w.]+) object at 0x[0-9a-f]+>", r"<\1>", sig)
+    return _strip_addresses(sig)
 
 
 def _doc(obj):
-    import re
-
-    doc = inspect.getdoc(obj) or ""
-    # flax dataclass auto-docstrings embed default-object reprs with
-    # run-dependent memory addresses; normalize for determinism
-    return re.sub(r"<([\w.]+) object at 0x[0-9a-f]+>", r"<\1>", doc)
+    if inspect.isclass(obj):
+        # the class's OWN docstring only: inspect.getdoc inherits the
+        # base's, which would duplicate a mixin-base docstring under every
+        # docstring-less subclass heading
+        doc = inspect.cleandoc(vars(obj).get("__doc__") or "")
+    else:
+        doc = inspect.getdoc(obj) or ""
+    return _strip_addresses(doc)
 
 
 def _is_public(name, obj, module):

@@ -100,7 +100,14 @@ def main(argv=None):
     parser.add_argument("--num_partitions", type=int, default=8)
     parser.add_argument("--tensorboard", action="store_true")
     parser.add_argument("--platform", default=None, help="force JAX_PLATFORMS in nodes (e.g. cpu)")
+    parser.add_argument(
+        "--auto_recover", type=int, default=0, metavar="N",
+        help="relaunch budget on node failure: run_with_recovery(feed_fn=...) "
+             "re-feeds the RDD against the relaunched cluster and nodes resume "
+             "from --model_dir's newest checkpoint (requires --model_dir)")
     args = parser.parse_args(argv)
+    if args.auto_recover and not args.model_dir:
+        parser.error("--auto_recover needs --model_dir (the resume point)")
 
     from tensorflowonspark_tpu import TFCluster
     from tensorflowonspark_tpu.backends.local import LocalSparkContext
@@ -114,14 +121,31 @@ def main(argv=None):
     sc = LocalSparkContext(num_executors=args.cluster_size)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     try:
-        cluster = TFCluster.run(
-            sc, main_fun, args, args.cluster_size,
-            input_mode=TFCluster.InputMode.SPARK, master_node="chief",
-            tensorboard=args.tensorboard, env=env,
-        )
-        cluster.train(sc.parallelize(data, args.num_partitions), num_epochs=args.epochs)
-        cluster.shutdown(grace_secs=5)
-        print("training complete")
+        if args.auto_recover:
+            # SPARK-mode recovery: the caller owns the feed, so recovery
+            # means re-invoking this feed loop against the relaunched
+            # cluster; main_fun resumes from the newest checkpoint
+            def feed_fn(cluster):
+                cluster.train(
+                    sc.parallelize(data, args.num_partitions), num_epochs=args.epochs
+                )
+
+            relaunches = TFCluster.run_with_recovery(
+                sc, main_fun, args, args.cluster_size,
+                max_relaunches=args.auto_recover,
+                input_mode=TFCluster.InputMode.SPARK, master_node="chief",
+                tensorboard=args.tensorboard, env=env, feed_fn=feed_fn,
+            )
+            print("training complete ({} relaunch(es))".format(relaunches))
+        else:
+            cluster = TFCluster.run(
+                sc, main_fun, args, args.cluster_size,
+                input_mode=TFCluster.InputMode.SPARK, master_node="chief",
+                tensorboard=args.tensorboard, env=env,
+            )
+            cluster.train(sc.parallelize(data, args.num_partitions), num_epochs=args.epochs)
+            cluster.shutdown(grace_secs=5)
+            print("training complete")
     finally:
         sc.stop()
 

@@ -47,6 +47,21 @@ def test_mnist_spark_mode(tmp_path):
     assert os.path.isdir(export_dir)
 
 
+def test_mnist_spark_mode_auto_recover(tmp_path):
+    """--auto_recover routes the SPARK feed through run_with_recovery's
+    feed_fn path (clean run here; the kill-mid-feed path is proven in
+    tests/test_recovery.py)."""
+    model_dir = str(tmp_path / "model")
+    out = _run(
+        "mnist/mnist_spark.py", "--cluster_size", "1", "--epochs", "1",
+        "--num_examples", "256", "--batch_size", "64",
+        "--model_dir", model_dir, "--checkpoint_steps", "2",
+        "--auto_recover", "1", "--platform", "cpu",
+    )
+    assert "training complete (0 relaunch(es))" in out
+    assert any(d.startswith("ckpt_") for d in os.listdir(model_dir))
+
+
 @pytest.mark.slow
 def test_mnist_estimator_with_evaluator(tmp_path):
     model_dir = str(tmp_path / "est")
