@@ -53,15 +53,15 @@ def main(argv=None):
         images, labels = synthetic_mnist(args.num_examples)
 
     from tensorflowonspark_tpu import dfutil
-    from tensorflowonspark_tpu.backends.local import LocalSparkContext
+    from tensorflowonspark_tpu.backends import create_dataframe, get_spark_context
 
-    sc = LocalSparkContext(num_executors=2)
+    sc, _n, owned = get_spark_context("mnist_data_setup", 2)
     try:
         rows = [
             (images[i].ravel().tolist(), int(labels[i])) for i in range(len(labels))
         ]
         if args.format == "tfrecords":
-            df = sc.createDataFrame(rows, ["image", "label"], args.num_partitions)
+            df = create_dataframe(sc, rows, ["image", "label"], args.num_partitions)
             dfutil.saveAsTFRecords(df, args.output)
         else:
             os.makedirs(args.output, exist_ok=True)
@@ -70,7 +70,8 @@ def main(argv=None):
                     f.write(",".join(str(x) for x in img) + "|" + str(lbl) + "\n")
         print("wrote {} examples to {}".format(len(rows), args.output))
     finally:
-        sc.stop()
+        if owned:
+            sc.stop()
 
 
 if __name__ == "__main__":

@@ -104,7 +104,7 @@ def _evaluate_forever(args, ctx):
         time.sleep(0.5)
 
 
-def main(argv=None):
+def main(argv=None, sc=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch_size", type=int, default=64)
     parser.add_argument("--checkpoint_steps", type=int, default=10)
@@ -118,7 +118,6 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     from tensorflowonspark_tpu import TFCluster
-    from tensorflowonspark_tpu.backends.local import LocalSparkContext
 
     sys.path.insert(0, os.path.dirname(__file__))
     from mnist_data_setup import synthetic_mnist
@@ -126,7 +125,11 @@ def main(argv=None):
     images, labels = synthetic_mnist(args.num_examples)
     data = [(images[i].ravel().tolist(), int(labels[i])) for i in range(len(labels))]
 
-    sc = LocalSparkContext(num_executors=args.cluster_size)
+    from tensorflowonspark_tpu.backends import get_spark_context
+
+    # spark-submit / pyspark when present, local backend otherwise;
+    # a caller-supplied sc is passed through with owned=False
+    sc, args.cluster_size, owned = get_spark_context("mnist_estimator", args.cluster_size, sc=sc)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     try:
         cluster = TFCluster.run(
@@ -152,7 +155,8 @@ def main(argv=None):
                 print("eval records:\n" + f.read().strip())
         print("estimator training complete")
     finally:
-        sc.stop()
+        if owned:
+            sc.stop()
 
 
 if __name__ == "__main__":

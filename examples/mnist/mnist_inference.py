@@ -44,7 +44,7 @@ def inference_fun(args, ctx):
     print("instance {}: {}/{} correct".format(ctx.executor_id, correct, total))
 
 
-def main(argv=None):
+def main(argv=None, sc=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch_size", type=int, default=256)
     parser.add_argument("--cluster_size", type=int, default=2)
@@ -55,15 +55,19 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     from tensorflowonspark_tpu import TFParallel
-    from tensorflowonspark_tpu.backends.local import LocalSparkContext
 
-    sc = LocalSparkContext(num_executors=args.cluster_size)
+    from tensorflowonspark_tpu.backends import get_spark_context
+
+    # spark-submit / pyspark when present, local backend otherwise;
+    # a caller-supplied sc is passed through with owned=False
+    sc, args.cluster_size, owned = get_spark_context("mnist_inference", args.cluster_size, sc=sc)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     try:
         TFParallel.run(sc, inference_fun, args, args.cluster_size, env=env)
         print("inference shards in", args.output)
     finally:
-        sc.stop()
+        if owned:
+            sc.stop()
 
 
 if __name__ == "__main__":

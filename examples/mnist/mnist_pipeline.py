@@ -59,7 +59,7 @@ def train_fun(args, ctx):
         export.export_model(args.export_dir, predict_builder, params)
 
 
-def main(argv=None):
+def main(argv=None, sc=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch_size", type=int, default=64)
     parser.add_argument("--cluster_size", type=int, default=2)
@@ -70,7 +70,6 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     from tensorflowonspark_tpu import pipeline
-    from tensorflowonspark_tpu.backends.local import LocalSparkContext
 
     sys.path.insert(0, os.path.dirname(__file__))
     from mnist_data_setup import synthetic_mnist
@@ -78,10 +77,14 @@ def main(argv=None):
     images, labels = synthetic_mnist(args.num_examples)
     rows = [(images[i].ravel().tolist(), int(labels[i])) for i in range(len(labels))]
 
-    sc = LocalSparkContext(num_executors=args.cluster_size)
+    from tensorflowonspark_tpu.backends import create_dataframe, get_spark_context
+
+    # spark-submit / pyspark when present, local backend otherwise;
+    # a caller-supplied sc is passed through with owned=False
+    sc, args.cluster_size, owned = get_spark_context("mnist_pipeline", args.cluster_size, sc=sc)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     try:
-        df = sc.createDataFrame(rows, ["image", "label"], 8)
+        df = create_dataframe(sc, rows, ["image", "label"], 8)
         est = (
             pipeline.TFEstimator(train_fun, vars(args), env=env)
             .setInputMapping({"image": "image", "label": "label"})
@@ -96,12 +99,13 @@ def main(argv=None):
         model.setInputMapping({"image": "image"}).setOutputMapping(
             {"prediction": "prediction"}
         ).setExportDir(args.export_dir)
-        test_df = sc.createDataFrame([(r[0],) for r in rows[:256]], ["image"], 4)
+        test_df = create_dataframe(sc, [(r[0],) for r in rows[:256]], ["image"], 4)
         preds = [r[0] for r in model.transform(test_df).collect()]
         acc = sum(int(p == labels[i]) for i, p in enumerate(preds)) / len(preds)
         print("pipeline inference accuracy on {} rows: {:.3f}".format(len(preds), acc))
     finally:
-        sc.stop()
+        if owned:
+            sc.stop()
 
 
 if __name__ == "__main__":

@@ -87,7 +87,7 @@ def main_fun(args, ctx):
         print("exported model bundle to", args.export_dir)
 
 
-def main(argv=None):
+def main(argv=None, sc=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch_size", type=int, default=64)
     parser.add_argument("--checkpoint_steps", type=int, default=100)
@@ -105,12 +105,16 @@ def main(argv=None):
         help="relaunch budget on node failure: run_with_recovery(feed_fn=...) "
              "re-feeds the RDD against the relaunched cluster and nodes resume "
              "from --model_dir's newest checkpoint (requires --model_dir)")
+    parser.add_argument(
+        "--jax_distributed", choices=["auto", "0", "1"], default="auto",
+        help="force the cross-process jax.distributed world on/off "
+             "(auto = the framework's default: on when >1 training node)")
     args = parser.parse_args(argv)
+    jax_distributed = None if args.jax_distributed == "auto" else args.jax_distributed == "1"
     if args.auto_recover and not args.model_dir:
         parser.error("--auto_recover needs --model_dir (the resume point)")
 
     from tensorflowonspark_tpu import TFCluster
-    from tensorflowonspark_tpu.backends.local import LocalSparkContext
 
     sys.path.insert(0, os.path.join(os.path.dirname(__file__)))
     from mnist_data_setup import synthetic_mnist
@@ -118,7 +122,11 @@ def main(argv=None):
     images, labels = synthetic_mnist(args.num_examples)
     data = [(images[i].ravel().tolist(), int(labels[i])) for i in range(len(labels))]
 
-    sc = LocalSparkContext(num_executors=args.cluster_size)
+    from tensorflowonspark_tpu.backends import get_spark_context
+
+    # spark-submit / pyspark when present, local backend otherwise;
+    # a caller-supplied sc is passed through with owned=False
+    sc, args.cluster_size, owned = get_spark_context("mnist_spark", args.cluster_size, sc=sc)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     try:
         if args.auto_recover:
@@ -135,6 +143,7 @@ def main(argv=None):
                 max_relaunches=args.auto_recover,
                 input_mode=TFCluster.InputMode.SPARK, master_node="chief",
                 tensorboard=args.tensorboard, env=env, feed_fn=feed_fn,
+                jax_distributed=jax_distributed,
             )
             print("training complete ({} relaunch(es))".format(relaunches))
         else:
@@ -142,12 +151,14 @@ def main(argv=None):
                 sc, main_fun, args, args.cluster_size,
                 input_mode=TFCluster.InputMode.SPARK, master_node="chief",
                 tensorboard=args.tensorboard, env=env,
+                jax_distributed=jax_distributed,
             )
             cluster.train(sc.parallelize(data, args.num_partitions), num_epochs=args.epochs)
             cluster.shutdown(grace_secs=5)
             print("training complete")
     finally:
-        sc.stop()
+        if owned:
+            sc.stop()
 
 
 if __name__ == "__main__":

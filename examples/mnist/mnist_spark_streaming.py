@@ -10,6 +10,13 @@ externally with ``examples/utils/stop_cluster.py <host> <port>`` (the
 reference's utils/stop_streaming.py analogue; the server address is printed
 at startup).
 
+This example drives the bundled local backend's streaming context (its
+``feed()`` API pushes waves incrementally). On real pyspark the same
+``cluster.train(dstream)`` path takes an actual DStream — exercised against
+a real ``queueStream`` on ``local-cluster`` in
+``tests/test_real_pyspark.py::test_streaming_foreachrdd_single_arg``
+(pyspark<4: Spark 4 removed DStreams).
+
 Usage:
     python examples/mnist/mnist_spark_streaming.py --cluster_size 2 \
         --num_waves 5 --wave_rows 512 --platform cpu

@@ -60,7 +60,7 @@ def main_fun(args, ctx):
             float(metrics["loss"]), float(metrics["accuracy"])))
 
 
-def main(argv=None):
+def main(argv=None, sc=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--data_dir", required=True)
     parser.add_argument("--batch_size", type=int, default=64)
@@ -71,9 +71,12 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     from tensorflowonspark_tpu import TFCluster
-    from tensorflowonspark_tpu.backends.local import LocalSparkContext
 
-    sc = LocalSparkContext(num_executors=args.cluster_size)
+    from tensorflowonspark_tpu.backends import get_spark_context
+
+    # spark-submit / pyspark when present, local backend otherwise;
+    # a caller-supplied sc is passed through with owned=False
+    sc, args.cluster_size, owned = get_spark_context("mnist_tf", args.cluster_size, sc=sc)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     try:
         cluster = TFCluster.run(
@@ -83,7 +86,8 @@ def main(argv=None):
         cluster.shutdown()
         print("training complete")
     finally:
-        sc.stop()
+        if owned:
+            sc.stop()
 
 
 if __name__ == "__main__":

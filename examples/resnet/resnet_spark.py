@@ -244,7 +244,7 @@ def main_fun(args, ctx):
             print("eval accuracy {:.4f} ({} examples)".format(correct / total, total))
 
 
-def main(argv=None):
+def main(argv=None, sc=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch_size", type=int, default=128)
     parser.add_argument("--cluster_size", type=int, default=1)
@@ -289,9 +289,12 @@ def main(argv=None):
         parser.error("--auto_recover requires --model_dir and --checkpoint_steps")
 
     from tensorflowonspark_tpu import TFCluster
-    from tensorflowonspark_tpu.backends.local import LocalSparkContext
 
-    sc = LocalSparkContext(num_executors=args.cluster_size)
+    from tensorflowonspark_tpu.backends import get_spark_context
+
+    # spark-submit / pyspark when present, local backend otherwise;
+    # a caller-supplied sc is passed through with owned=False
+    sc, args.cluster_size, owned = get_spark_context("resnet_spark", args.cluster_size, sc=sc)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     try:
         if args.auto_recover:
@@ -310,7 +313,8 @@ def main(argv=None):
             cluster.shutdown()
             print("resnet training complete")
     finally:
-        sc.stop()
+        if owned:
+            sc.stop()
 
 
 if __name__ == "__main__":

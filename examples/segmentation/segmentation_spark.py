@@ -117,7 +117,7 @@ def inference_fun(args, ctx):
         ctx.executor_id, len(sel), acc))
 
 
-def main(argv=None):
+def main(argv=None, sc=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--base_filters", type=int, default=16)
     parser.add_argument("--batch_size", type=int, default=8)
@@ -131,9 +131,12 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     from tensorflowonspark_tpu import TFCluster, TFParallel
-    from tensorflowonspark_tpu.backends.local import LocalSparkContext
 
-    sc = LocalSparkContext(num_executors=args.cluster_size)
+    from tensorflowonspark_tpu.backends import get_spark_context
+
+    # spark-submit / pyspark when present, local backend otherwise;
+    # a caller-supplied sc is passed through with owned=False
+    sc, args.cluster_size, owned = get_spark_context("segmentation_spark", args.cluster_size, sc=sc)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     try:
         cluster = TFCluster.run(
@@ -147,7 +150,8 @@ def main(argv=None):
             TFParallel.run(sc, inference_fun, args, args.cluster_size, env=env)
             print("segmentation inference complete")
     finally:
-        sc.stop()
+        if owned:
+            sc.stop()
 
 
 if __name__ == "__main__":

@@ -118,7 +118,7 @@ def main_fun(args, ctx):
     print("transformer training complete: mesh={}".format(dict(zip(mesh.axis_names, mesh.devices.shape))))
 
 
-def main(argv=None):
+def main(argv=None, sc=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch_size", type=int, default=8)
     parser.add_argument("--cluster_size", type=int, default=1)
@@ -142,9 +142,12 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     from tensorflowonspark_tpu import TFCluster
-    from tensorflowonspark_tpu.backends.local import LocalSparkContext
 
-    sc = LocalSparkContext(num_executors=args.cluster_size)
+    from tensorflowonspark_tpu.backends import get_spark_context
+
+    # spark-submit / pyspark when present, local backend otherwise;
+    # a caller-supplied sc is passed through with owned=False
+    sc, args.cluster_size, owned = get_spark_context("transformer_spark", args.cluster_size, sc=sc)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     if args.platform == "cpu" and args.mesh:
         # expose enough virtual devices for the requested mesh
@@ -160,7 +163,8 @@ def main(argv=None):
         cluster.shutdown()
         print("transformer run complete")
     finally:
-        sc.stop()
+        if owned:
+            sc.stop()
 
 
 if __name__ == "__main__":

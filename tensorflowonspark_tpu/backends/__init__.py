@@ -19,3 +19,90 @@ def is_spark_context(sc):
     not be installed at all)."""
     mod = type(sc).__module__ or ""
     return mod.startswith("pyspark")
+
+
+def create_dataframe(sc, rows, columns, num_partitions=None):
+    """Build a DataFrame on either backend: the local backend's
+    ``createDataFrame`` (LocalDataFrame), or — on a real pyspark
+    SparkContext, which has no such method — the session's
+    ``createDataFrame`` over a parallelized RDD."""
+    if is_spark_context(sc):
+        from pyspark.sql import SparkSession
+
+        rdd = (
+            sc.parallelize(rows, num_partitions)
+            if num_partitions else sc.parallelize(rows)
+        )
+        return SparkSession(sc).createDataFrame(rdd, list(columns))
+    return sc.createDataFrame(rows, list(columns), num_partitions)
+
+
+def get_spark_context(app_name, num_executors=None, task_timeout=600, sc=None):
+    """The examples' context factory: a REAL ``pyspark.SparkContext`` when
+    the program is running under Spark, the bundled local backend otherwise.
+    Returns ``(sc, num_executors, owned)`` — ``owned`` False when the
+    context came from the caller or an already-active pyspark context was
+    reused (don't stop what you did not create).
+
+    Pass ``sc`` to inject an existing context of either backend (tests, or
+    apps that built their own): it is returned as-is with ``owned=False``.
+
+    "Running under Spark" means pyspark is importable AND one of: an active
+    SparkContext already exists (spark-submit re-running the driver),
+    ``MASTER``/``SPARK_MASTER`` is set, spark-submit's launch scripts ran
+    (``SPARK_ENV_LOADED``), or ``TOS_SPARK=1`` forces it. ``TOS_SPARK=0``
+    forces the local backend even with pyspark installed.
+
+    Executor-count resolution on the real path: ``spark.executor.instances``
+    from the submitted conf (deployment truth — the reference examples' own
+    rule, e.g. reference examples/mnist/keras/mnist_spark.py:29-31), else
+    the caller's ``num_executors`` (an explicit ``--cluster_size`` must not
+    be silently overridden), else ``defaultParallelism``.
+    """
+    import logging
+    import os
+
+    logger = logging.getLogger(__name__)
+    if sc is not None:
+        return sc, (num_executors or 1), False
+    forced = os.environ.get("TOS_SPARK")
+    use_spark = False
+    if forced != "0":
+        try:
+            import pyspark
+
+            active = pyspark.SparkContext._active_spark_context is not None
+            use_spark = (
+                forced == "1"
+                or active
+                or bool(os.environ.get("MASTER") or os.environ.get("SPARK_MASTER"))
+                or bool(os.environ.get("SPARK_ENV_LOADED"))
+            )
+        except ImportError:
+            if forced == "1":
+                raise
+    if use_spark:
+        import pyspark
+
+        existing = pyspark.SparkContext._active_spark_context
+        owned = existing is None
+        conf = pyspark.SparkConf().setAppName(app_name)
+        master = os.environ.get("MASTER") or os.environ.get("SPARK_MASTER")
+        if owned and master and not conf.contains("spark.master"):
+            conf.setMaster(master)
+        sc = existing if existing is not None else pyspark.SparkContext(conf=conf)
+        instances = sc.getConf().get("spark.executor.instances")
+        resolved = (
+            int(instances) if instances
+            else (num_executors or sc.defaultParallelism or 1)
+        )
+        logger.info(
+            "using real pyspark SparkContext (master=%s, %d executors)",
+            sc.master, resolved,
+        )
+        return sc, resolved, owned
+
+    from tensorflowonspark_tpu.backends.local import LocalSparkContext
+
+    n = num_executors or 1
+    return LocalSparkContext(num_executors=n, task_timeout=task_timeout), n, True

@@ -316,6 +316,68 @@ def test_ml_pipeline_fit_transform(sc, tmp_path):
     np.testing.assert_allclose(np.asarray(preds).ravel(), expected, atol=0.5)
 
 
+def test_get_spark_context_reuses_active_context(sc):
+    """Under spark-submit (an active SparkContext exists) the examples'
+    context factory must REUSE it, never construct a second one, and must
+    follow the documented executor-count resolution: submitted
+    spark.executor.instances first, then the caller's explicit count (which
+    must not be silently overridden), then defaultParallelism."""
+    from tensorflowonspark_tpu.backends import create_dataframe, get_spark_context
+
+    instances = sc.getConf().get("spark.executor.instances")
+    got, n, owned = get_spark_context("reuse-test", 7)
+    assert got is sc
+    assert not owned  # caller must not stop a context it did not create
+    assert n == (int(instances) if instances else 7)
+
+    got2, n2, owned2 = get_spark_context("reuse-test", None)
+    assert got2 is sc and not owned2
+    assert n2 == (int(instances) if instances else (sc.defaultParallelism or 1))
+
+    injected, n3, owned3 = get_spark_context("reuse-test", 3, sc=sc)
+    assert injected is sc and n3 == 3 and not owned3
+
+    df = create_dataframe(sc, [(1, "a"), (2, "b")], ["x", "y"], 2)
+    assert sorted(r["x"] for r in df.collect()) == [1, 2]
+
+
+def test_example_mnist_spark_under_real_spark(sc, tmp_path):
+    """The mnist_spark example end-to-end on the REAL local-cluster: the
+    north-star deployment shape is 'launched purely via spark-submit', so
+    the example itself (not just the framework) must run on real Spark."""
+    example_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples", "mnist",
+    )
+    sys.path.insert(0, example_dir)
+    try:
+        import mnist_data_setup
+        import mnist_spark
+
+        # example modules are not importable on executors: ship by value
+        # through BOTH picklers (pyspark task closures + the jax-child spawn)
+        for mod in (mnist_spark, mnist_data_setup):
+            cloudpickle.register_pickle_by_value(mod)
+            try:
+                _pyspark_cloudpickle.register_pickle_by_value(mod)
+            except NameError:
+                pass
+
+        export_dir = str(tmp_path / "bundle")
+        mnist_spark.main(
+            [
+                "--cluster_size", "2", "--epochs", "1",
+                "--num_examples", "256", "--batch_size", "32",
+                "--export_dir", export_dir, "--platform", "cpu",
+                "--jax_distributed", "0",
+            ],
+            sc=sc,  # the module-scoped context: one SparkContext per JVM
+        )
+        assert os.path.isdir(export_dir)
+    finally:
+        sys.path.remove(example_dir)
+
+
 def fn_instance(args, ctx):
     with open(os.path.join(args["out_dir"], "inst{}.txt".format(ctx.executor_id)), "w") as f:
         f.write("{}/{}".format(ctx.executor_id, ctx.num_workers))
