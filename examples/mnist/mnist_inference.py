@@ -47,7 +47,8 @@ def inference_fun(args, ctx):
 def main(argv=None, sc=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch_size", type=int, default=256)
-    parser.add_argument("--cluster_size", type=int, default=2)
+    parser.add_argument("--cluster_size", type=int, default=None,
+                        help="explicit cluster size (default: from the Spark conf/parallelism under Spark; 2 on the local backend)")
     parser.add_argument("--export_dir", required=True)
     parser.add_argument("--num_examples", type=int, default=2048)
     parser.add_argument("--output", required=True)
@@ -60,7 +61,7 @@ def main(argv=None, sc=None):
 
     # spark-submit / pyspark when present, local backend otherwise;
     # a caller-supplied sc is passed through with owned=False
-    sc, args.cluster_size, owned = get_spark_context("mnist_inference", args.cluster_size, sc=sc)
+    sc, args.cluster_size, owned = get_spark_context("mnist_inference", args.cluster_size, sc=sc, local_default=2)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     try:
         TFParallel.run(sc, inference_fun, args, args.cluster_size, env=env)

@@ -62,7 +62,8 @@ def train_fun(args, ctx):
 def main(argv=None, sc=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch_size", type=int, default=64)
-    parser.add_argument("--cluster_size", type=int, default=2)
+    parser.add_argument("--cluster_size", type=int, default=None,
+                        help="explicit cluster size (default: from the Spark conf/parallelism under Spark; 2 on the local backend)")
     parser.add_argument("--epochs", type=int, default=3)
     parser.add_argument("--export_dir", required=True)
     parser.add_argument("--num_examples", type=int, default=4096)
@@ -81,7 +82,7 @@ def main(argv=None, sc=None):
 
     # spark-submit / pyspark when present, local backend otherwise;
     # a caller-supplied sc is passed through with owned=False
-    sc, args.cluster_size, owned = get_spark_context("mnist_pipeline", args.cluster_size, sc=sc)
+    sc, args.cluster_size, owned = get_spark_context("mnist_pipeline", args.cluster_size, sc=sc, local_default=2)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     try:
         df = create_dataframe(sc, rows, ["image", "label"], 8)

@@ -10,6 +10,12 @@ Parity with /root/reference/examples/mnist/keras/mnist_spark.py: same flow
 Usage:
     python examples/mnist/mnist_spark.py --cluster_size 2 --epochs 3 \
         --model_dir /tmp/mnist_model --export_dir /tmp/mnist_export
+
+Under spark-submit the same script runs on a real cluster unchanged
+(context + executor count resolve via backends.get_spark_context):
+
+    spark-submit --master $MASTER --conf spark.executor.instances=N \
+        examples/mnist/mnist_spark.py [args...]
 """
 
 import argparse
@@ -91,7 +97,8 @@ def main(argv=None, sc=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch_size", type=int, default=64)
     parser.add_argument("--checkpoint_steps", type=int, default=100)
-    parser.add_argument("--cluster_size", type=int, default=2)
+    parser.add_argument("--cluster_size", type=int, default=None,
+                        help="explicit cluster size (default: from the Spark conf/parallelism under Spark; 2 on the local backend)")
     parser.add_argument("--epochs", type=int, default=3)
     parser.add_argument("--learning_rate", type=float, default=1e-3)
     parser.add_argument("--model_dir", default=None)
@@ -126,7 +133,7 @@ def main(argv=None, sc=None):
 
     # spark-submit / pyspark when present, local backend otherwise;
     # a caller-supplied sc is passed through with owned=False
-    sc, args.cluster_size, owned = get_spark_context("mnist_spark", args.cluster_size, sc=sc)
+    sc, args.cluster_size, owned = get_spark_context("mnist_spark", args.cluster_size, sc=sc, local_default=2)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     try:
         if args.auto_recover:

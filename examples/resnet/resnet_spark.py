@@ -18,6 +18,12 @@ Input paths, matching the reference's two modes:
 Usage:
     python examples/resnet/resnet_spark.py --dataset cifar --train_steps 100 \
         --data_dir /data/cifar_tfrecords
+
+Under spark-submit the same script runs on a real cluster unchanged
+(context + executor count resolve via backends.get_spark_context):
+
+    spark-submit --master $MASTER --conf spark.executor.instances=N \
+        examples/resnet/resnet_spark.py [args...]
 """
 
 import argparse
@@ -247,7 +253,8 @@ def main_fun(args, ctx):
 def main(argv=None, sc=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch_size", type=int, default=128)
-    parser.add_argument("--cluster_size", type=int, default=1)
+    parser.add_argument("--cluster_size", type=int, default=None,
+                        help="explicit cluster size (default: from the Spark conf/parallelism under Spark; 1 on the local backend)")
     parser.add_argument("--data_dir", default=None, help="TFRecord shard dir (real-data mode)")
     parser.add_argument("--data_threads", type=int, default=8)
     parser.add_argument("--dataset", choices=["cifar", "imagenet"], default="cifar")
@@ -294,7 +301,7 @@ def main(argv=None, sc=None):
 
     # spark-submit / pyspark when present, local backend otherwise;
     # a caller-supplied sc is passed through with owned=False
-    sc, args.cluster_size, owned = get_spark_context("resnet_spark", args.cluster_size, sc=sc)
+    sc, args.cluster_size, owned = get_spark_context("resnet_spark", args.cluster_size, sc=sc, local_default=1)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     try:
         if args.auto_recover:

@@ -121,7 +121,8 @@ def main(argv=None, sc=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--base_filters", type=int, default=16)
     parser.add_argument("--batch_size", type=int, default=8)
-    parser.add_argument("--cluster_size", type=int, default=1)
+    parser.add_argument("--cluster_size", type=int, default=None,
+                        help="explicit cluster size (default: from the Spark conf/parallelism under Spark; 1 on the local backend)")
     parser.add_argument("--depth", type=int, default=3)
     parser.add_argument("--export_dir", default=None)
     parser.add_argument("--image_size", type=int, default=128)
@@ -136,7 +137,7 @@ def main(argv=None, sc=None):
 
     # spark-submit / pyspark when present, local backend otherwise;
     # a caller-supplied sc is passed through with owned=False
-    sc, args.cluster_size, owned = get_spark_context("segmentation_spark", args.cluster_size, sc=sc)
+    sc, args.cluster_size, owned = get_spark_context("segmentation_spark", args.cluster_size, sc=sc, local_default=1)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     try:
         cluster = TFCluster.run(

@@ -121,7 +121,8 @@ def main_fun(args, ctx):
 def main(argv=None, sc=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch_size", type=int, default=8)
-    parser.add_argument("--cluster_size", type=int, default=1)
+    parser.add_argument("--cluster_size", type=int, default=None,
+                        help="explicit cluster size (default: from the Spark conf/parallelism under Spark; 1 on the local backend)")
     parser.add_argument("--d_ff", type=int, default=1024)
     parser.add_argument("--d_model", type=int, default=256)
     parser.add_argument("--dtype", default="bfloat16")
@@ -147,7 +148,7 @@ def main(argv=None, sc=None):
 
     # spark-submit / pyspark when present, local backend otherwise;
     # a caller-supplied sc is passed through with owned=False
-    sc, args.cluster_size, owned = get_spark_context("transformer_spark", args.cluster_size, sc=sc)
+    sc, args.cluster_size, owned = get_spark_context("transformer_spark", args.cluster_size, sc=sc, local_default=1)
     env = {"JAX_PLATFORMS": args.platform} if args.platform else None
     if args.platform == "cpu" and args.mesh:
         # expose enough virtual devices for the requested mesh

@@ -37,7 +37,8 @@ def create_dataframe(sc, rows, columns, num_partitions=None):
     return sc.createDataFrame(rows, list(columns), num_partitions)
 
 
-def get_spark_context(app_name, num_executors=None, task_timeout=600, sc=None):
+def get_spark_context(app_name, num_executors=None, task_timeout=600, sc=None,
+                      local_default=1):
     """The examples' context factory: a REAL ``pyspark.SparkContext`` when
     the program is running under Spark, the bundled local backend otherwise.
     Returns ``(sc, num_executors, owned)`` — ``owned`` False when the
@@ -53,18 +54,22 @@ def get_spark_context(app_name, num_executors=None, task_timeout=600, sc=None):
     (``SPARK_ENV_LOADED``), or ``TOS_SPARK=1`` forces it. ``TOS_SPARK=0``
     forces the local backend even with pyspark installed.
 
-    Executor-count resolution on the real path: ``spark.executor.instances``
-    from the submitted conf (deployment truth — the reference examples' own
-    rule, e.g. reference examples/mnist/keras/mnist_spark.py:29-31), else
-    the caller's ``num_executors`` (an explicit ``--cluster_size`` must not
-    be silently overridden), else ``defaultParallelism``.
+    ``num_executors`` is the user's EXPLICIT request (examples pass their
+    ``--cluster_size`` flag with ``default=None``). Resolution on the real
+    path: ``spark.executor.instances`` from the submitted conf (deployment
+    truth — the reference examples' own rule, e.g. reference
+    examples/mnist/keras/mnist_spark.py:29-31), else the explicit request
+    (which must never be silently overridden), else ``defaultParallelism``
+    (standalone clusters don't set ``instances`` — size from the cluster,
+    not from an example's argparse default). On the local backend:
+    the explicit request, else ``local_default``.
     """
     import logging
     import os
 
     logger = logging.getLogger(__name__)
     if sc is not None:
-        return sc, (num_executors or 1), False
+        return sc, (num_executors or local_default), False
     forced = os.environ.get("TOS_SPARK")
     use_spark = False
     if forced != "0":
@@ -104,5 +109,5 @@ def get_spark_context(app_name, num_executors=None, task_timeout=600, sc=None):
 
     from tensorflowonspark_tpu.backends.local import LocalSparkContext
 
-    n = num_executors or 1
+    n = num_executors or local_default
     return LocalSparkContext(num_executors=n, task_timeout=task_timeout), n, True

@@ -45,6 +45,18 @@ def test_tos_spark_1_without_pyspark_raises(monkeypatch):
         get_spark_context("ctx-test", 1)
 
 
+def test_local_default_used_when_no_explicit_size(monkeypatch):
+    """Examples pass --cluster_size default=None; locally the per-example
+    local_default applies (under Spark the cluster's conf/parallelism
+    would — pinned in the CI real-pyspark leg)."""
+    monkeypatch.setenv("TOS_SPARK", "0")
+    sc, n, owned = get_spark_context("ctx-test", None, local_default=2)
+    try:
+        assert n == 2 and owned
+    finally:
+        sc.stop()
+
+
 def test_create_dataframe_local_backend():
     sc = LocalSparkContext(num_executors=1)
     try:
