@@ -134,19 +134,12 @@ def bench_resnet(tiny, real_data):
             prefetch_batches=max(4, 2 * fused),
         )
         raw_iter = iter(pipe)
-        # Link-ceiling probe, r5 redesign (history in docs/perf.md): SUSTAINED
-        # back-to-back transfers of REAL decoded batches in the run's actual
-        # transfer shape, drawn FRESH from the same pipeline the training
-        # loop eats from. Three generations of probe bias, each measured:
-        # r3 min-of-3 zeros overstated ~2x (best-mood sampling + the relay
-        # compresses zeros); r4 shipped one window per probe — short enough
-        # to ride a single link burst (probes swung 42-164 img/s around
-        # train blocks stable at ~50); early r5 re-shipped the SAME held
-        # window every probe with the decode pipeline paused, which a
-        # compressing relay serves faster than training's never-repeated
-        # stream (probes agreed at 113 while training sustained 74). Now a
-        # probe = two fresh windows, fenced each: same bytes novelty, same
-        # decode contention, same transfer shape as the timed blocks.
+        # One-shot transfer probes, used ONLY to pick the transfer shape
+        # (per-batch vs packed window) and to seed the block-size estimate.
+        # They draw FRESH batches through the same pipeline the training
+        # loop eats (this relay compresses repeat content — perf.md). The
+        # measurement denominator is NOT these probes: it is the no-compute
+        # blocks below (probe designs and their measured biases: perf.md).
         # Tiny (CPU/CI) runs skip the probes: no link to probe.
 
         def _fence(x):
@@ -164,9 +157,9 @@ def bench_resnet(tiny, real_data):
 
         win = max(fused, 1)
 
-        def probe_per_batch(nwin=2):
-            # every batch fenced: sequential sustained transfers in the
-            # per-batch dispatch shape
+        def probe_per_batch(nwin=1):
+            # every batch fenced: sequential transfers in the per-batch
+            # dispatch shape
             n = nwin * win
             fresh = [next(raw_iter) for _ in range(n)]
             _flush_link()
@@ -175,13 +168,9 @@ def bench_resnet(tiny, real_data):
                 _fence(strategy.shard_batch(b))
             return n * batch / (time.perf_counter() - t0)
 
-        def probe_packed(nwin=2):
+        def probe_packed(nwin=1):
             from tensorflowonspark_tpu.data import packed_place
 
-            # draw the FIRST window before the clock (parity with the timed
-            # blocks, whose prefetch keeps a decoded window ready) but pull
-            # later windows inside it, so the probe pays the same decode
-            # contention the training loop pays
             windows = [[next(raw_iter) for _ in range(win)]]
             _flush_link()
             t0 = time.perf_counter()

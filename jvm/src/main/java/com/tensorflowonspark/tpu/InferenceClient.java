@@ -270,9 +270,16 @@ public final class InferenceClient implements Closeable {
               "column name " + c.name + " contains a character unsafe for the JSON header");
         }
       }
-      if (!("<f4".equals(c.dtype) || "<f8".equals(c.dtype)
-          || "<i4".equals(c.dtype) || "<i8".equals(c.dtype))) {
-        throw new IllegalArgumentException("column " + c.name + ": unsupported dtype " + c.dtype);
+      // dtype ships verbatim in the JSON header too; the server accepts any
+      // numpy dtype string (uint8 image tensors are a normal payload), so
+      // validate SAFETY and form, not a whitelist — byteSize() below already
+      // requires a parseable "<kN" width
+      for (int i = 0; i < c.dtype.length(); i++) {
+        char ch = c.dtype.charAt(i);
+        if (ch == '"' || ch == '\\' || ch < 0x20) {
+          throw new IllegalArgumentException(
+              "column " + c.name + ": dtype " + c.dtype + " unsafe for the JSON header");
+        }
       }
       if (c.data.remaining() != c.byteSize()) {
         throw new IllegalArgumentException(

@@ -121,6 +121,11 @@ class InferenceClientTest {
     InferenceClient.Column ok = InferenceClient.Column.ofFloats(
         "ok", new int[] {2, 2}, new float[] {1, 2, 3, 4});
     assertEquals(16, ok.byteSize());
+    // non-f4/i8 dtypes (e.g. uint8 image tensors) size correctly too —
+    // the client must not whitelist away dtypes the server accepts
+    InferenceClient.Column u8 = new InferenceClient.Column(
+        "img", "<u1", new int[] {2, 3}, java.nio.ByteBuffer.allocate(6));
+    assertEquals(6, u8.byteSize());
   }
 
   @Test

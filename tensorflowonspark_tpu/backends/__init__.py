@@ -55,21 +55,21 @@ def get_spark_context(app_name, num_executors=None, task_timeout=600, sc=None,
     forces the local backend even with pyspark installed.
 
     ``num_executors`` is the user's EXPLICIT request (examples pass their
-    ``--cluster_size`` flag with ``default=None``). Resolution on the real
-    path: ``spark.executor.instances`` from the submitted conf (deployment
-    truth — the reference examples' own rule, e.g. reference
-    examples/mnist/keras/mnist_spark.py:29-31), else the explicit request
-    (which must never be silently overridden), else ``defaultParallelism``
-    (standalone clusters don't set ``instances`` — size from the cluster,
-    not from an example's argparse default). On the local backend:
-    the explicit request, else ``local_default``.
+    ``--cluster_size`` flag with ``default=None``) and always wins — with a
+    WARNING when it disagrees with the submitted conf. Without it, a real
+    context sizes from ``spark.executor.instances`` (the reference
+    examples' own rule, e.g. reference examples/mnist/keras/
+    mnist_spark.py:29-31), else ``defaultParallelism`` (standalone
+    clusters don't set ``instances``), else ``local_default``; the local
+    backend uses ``local_default``. The same resolution applies to an
+    injected ``sc``.
     """
     import logging
     import os
 
     logger = logging.getLogger(__name__)
     if sc is not None:
-        return sc, (num_executors or local_default), False
+        return sc, _resolve_executor_count(sc, num_executors, local_default, logger), False
     forced = os.environ.get("TOS_SPARK")
     use_spark = False
     if forced != "0":
@@ -96,11 +96,7 @@ def get_spark_context(app_name, num_executors=None, task_timeout=600, sc=None,
         if owned and master and not conf.contains("spark.master"):
             conf.setMaster(master)
         sc = existing if existing is not None else pyspark.SparkContext(conf=conf)
-        instances = sc.getConf().get("spark.executor.instances")
-        resolved = (
-            int(instances) if instances
-            else (num_executors or sc.defaultParallelism or 1)
-        )
+        resolved = _resolve_executor_count(sc, num_executors, local_default, logger)
         logger.info(
             "using real pyspark SparkContext (master=%s, %d executors)",
             sc.master, resolved,
@@ -111,3 +107,25 @@ def get_spark_context(app_name, num_executors=None, task_timeout=600, sc=None,
 
     n = num_executors or local_default
     return LocalSparkContext(num_executors=n, task_timeout=task_timeout), n, True
+
+
+def _resolve_executor_count(sc, num_executors, local_default, logger):
+    """get_spark_context's sizing rule, shared by the active-context and
+    injected-``sc`` paths: explicit request > submitted conf >
+    defaultParallelism > local_default."""
+    instances = None
+    if is_spark_context(sc):
+        raw = sc.getConf().get("spark.executor.instances")
+        instances = int(raw) if raw else None
+    if num_executors:
+        if instances and instances != num_executors:
+            logger.warning(
+                "explicit cluster size %d overrides spark.executor.instances=%d",
+                num_executors, instances,
+            )
+        return num_executors
+    if instances:
+        return instances
+    if is_spark_context(sc):
+        return sc.defaultParallelism or local_default
+    return local_default

@@ -202,8 +202,15 @@ class _Predictor:
 
     def stop(self):
         with self._submit_lock:
-            self._stopped = True
-            self._q.put(self._stop)
+            if not self._stopped:
+                # first stop only: the bounded queue holds at most
+                # max_pending requests (submit gates on that), so the +1
+                # slot guarantees this put never blocks — but a SECOND
+                # sentinel would fill the queue and block forever while
+                # holding _submit_lock. stop() must stay idempotent
+                # (server shutdown paths can reach it more than once).
+                self._stopped = True
+                self._q.put(self._stop)
         self._thread.join(timeout=60)
         if self._thread.is_alive():
             # an in-flight predict (e.g. a first-call XLA compile) outlived

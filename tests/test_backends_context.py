@@ -65,3 +65,14 @@ def test_create_dataframe_local_backend():
         assert sorted(row[0] for row in df.collect()) == [1, 3]
     finally:
         sc.stop()
+
+
+def test_injected_local_context_uses_local_default():
+    sc = LocalSparkContext(num_executors=2)
+    try:
+        got, n, owned = get_spark_context("ctx-test", None, sc=sc, local_default=2)
+        assert got is sc and n == 2 and not owned
+        got, n, owned = get_spark_context("ctx-test", 5, sc=sc)
+        assert n == 5 and not owned  # explicit request always wins
+    finally:
+        sc.stop()

@@ -319,16 +319,16 @@ def test_ml_pipeline_fit_transform(sc, tmp_path):
 def test_get_spark_context_reuses_active_context(sc):
     """Under spark-submit (an active SparkContext exists) the examples'
     context factory must REUSE it, never construct a second one, and must
-    follow the documented executor-count resolution: submitted
-    spark.executor.instances first, then the caller's explicit count (which
-    must not be silently overridden), then defaultParallelism."""
+    follow the documented executor-count resolution: an explicit request
+    always wins (warned when the conf disagrees), else submitted
+    spark.executor.instances, else defaultParallelism."""
     from tensorflowonspark_tpu.backends import create_dataframe, get_spark_context
 
     instances = sc.getConf().get("spark.executor.instances")
     got, n, owned = get_spark_context("reuse-test", 7)
     assert got is sc
     assert not owned  # caller must not stop a context it did not create
-    assert n == (int(instances) if instances else 7)
+    assert n == 7  # explicit request is never silently overridden
 
     got2, n2, owned2 = get_spark_context("reuse-test", None)
     assert got2 is sc and not owned2
@@ -336,6 +336,10 @@ def test_get_spark_context_reuses_active_context(sc):
 
     injected, n3, owned3 = get_spark_context("reuse-test", 3, sc=sc)
     assert injected is sc and n3 == 3 and not owned3
+    # injected real context without an explicit size: same conf/parallelism
+    # resolution as the active-context path, never a local default
+    _, n4, _ = get_spark_context("reuse-test", None, sc=sc, local_default=99)
+    assert n4 == (int(instances) if instances else (sc.defaultParallelism or 99))
 
     df = create_dataframe(sc, [(1, "a"), (2, "b")], ["x", "y"], 2)
     assert sorted(r["x"] for r in df.collect()) == [1, 2]

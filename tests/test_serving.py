@@ -712,3 +712,21 @@ def test_coalesce_respects_max_rows_cap():
     finally:
         release.set()
         pred.stop()
+
+
+def test_predictor_stop_is_idempotent():
+    """A second stop() must not block: with the bounded queue, a second
+    sentinel could fill the +1 slot and deadlock while holding the submit
+    lock (server shutdown paths can reach stop() more than once)."""
+    import threading
+
+    from tensorflowonspark_tpu.serving import _Predictor
+
+    pred = _Predictor(lambda p, ms, a: {"y": a["x"]}, None, None, max_pending=1)
+    pred.stop()
+    second = threading.Thread(target=pred.stop)
+    second.start()
+    second.join(timeout=10)
+    assert not second.is_alive(), "second stop() blocked"
+    with pytest.raises(RuntimeError):
+        pred.submit({"x": np.ones((1, 2), np.float32)})
