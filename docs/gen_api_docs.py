@@ -38,6 +38,11 @@ MODULES = [
     "tensorflowonspark_tpu.serving",
     "tensorflowonspark_tpu.compat",
     "tensorflowonspark_tpu.util",
+    "tensorflowonspark_tpu.obs",
+    "tensorflowonspark_tpu.obs.registry",
+    "tensorflowonspark_tpu.obs.aggregate",
+    "tensorflowonspark_tpu.obs.exporter",
+    "tensorflowonspark_tpu.obs.trace",
     "tensorflowonspark_tpu.parallel.mesh",
     "tensorflowonspark_tpu.parallel.sharding",
     "tensorflowonspark_tpu.parallel.collectives",

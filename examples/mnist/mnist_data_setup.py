@@ -75,4 +75,7 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
+    from tensorflowonspark_tpu import util
+
+    util.setup_logging()
     main()

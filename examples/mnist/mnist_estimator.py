@@ -161,4 +161,7 @@ def main(argv=None, sc=None):
 
 
 if __name__ == "__main__":
+    from tensorflowonspark_tpu import util
+
+    util.setup_logging()
     main()

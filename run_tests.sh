@@ -21,6 +21,9 @@ for arg in "$@"; do
   fi
 done
 
+# lint gate: library modules must not configure logging at import time
+python scripts/check_no_basicconfig.py
+
 export JAX_PLATFORMS=cpu
 if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
   export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"

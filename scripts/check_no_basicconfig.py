@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Lint: no module-level ``logging.basicConfig`` in library code.
+
+Configuring the root logger at import time hijacks logging from every
+application that imports the package (the bug this repo shipped until the
+observability PR: ``tensorflowonspark_tpu/__init__.py`` called basicConfig on
+import). Applications opt in via ``tensorflowonspark_tpu.util.setup_logging``;
+library modules must not configure logging as an import side effect.
+
+Scope: every ``*.py`` under ``tensorflowonspark_tpu/``. Calls INSIDE a
+function or method body (e.g. a CLI ``main()``) are fine — only calls that
+execute on import are flagged. ``util.setup_logging`` itself is the one
+sanctioned wrapper.
+
+Exit status: 0 clean, 1 with findings (one ``path:line`` per offence).
+"""
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIBRARY_ROOT = os.path.join(REPO, "tensorflowonspark_tpu")
+
+
+def _is_basicconfig(call):
+    fn = call.func
+    return (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "basicConfig"
+        and isinstance(fn.value, ast.Name)
+        and fn.value.id == "logging"
+    )
+
+
+def module_level_basicconfig(tree):
+    """Line numbers of logging.basicConfig calls that run at import time:
+    anything not nested inside a function/lambda (class bodies DO execute on
+    import, so a basicConfig in a class body is still flagged)."""
+    findings = []
+
+    def visit(node, in_function):
+        for child in ast.iter_child_nodes(node):
+            child_in_function = in_function or isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            )
+            if (
+                not in_function
+                and isinstance(child, ast.Call)
+                and _is_basicconfig(child)
+            ):
+                findings.append(child.lineno)
+            visit(child, child_in_function)
+
+    visit(tree, False)
+    return findings
+
+
+def main():
+    offences = []
+    for dirpath, _dirnames, filenames in os.walk(LIBRARY_ROOT):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path) as f:
+                source = f.read()
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError as e:
+                offences.append("{}:{}: unparseable: {}".format(path, e.lineno, e.msg))
+                continue
+            for lineno in module_level_basicconfig(tree):
+                offences.append(
+                    "{}:{}: module-level logging.basicConfig (use "
+                    "util.setup_logging from an entry point instead)".format(
+                        os.path.relpath(path, REPO), lineno
+                    )
+                )
+    for line in offences:
+        print(line)
+    if offences:
+        return 1
+    print("check_no_basicconfig: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,8 @@ import threading
 import time as _time
 
 from tensorflowonspark_tpu import TFSparkNode, TFManager, reservation
+from tensorflowonspark_tpu.obs import aggregate as obs_aggregate
+from tensorflowonspark_tpu.obs import registry as obs_registry
 
 logger = logging.getLogger(__name__)
 
@@ -505,6 +507,49 @@ class TFCluster:
                 return "http://{}:{}".format(row["host"], row["tb_port"])
         return None
 
+    def metrics(self, include_driver=True):
+        """One merged metrics snapshot for the whole cluster.
+
+        Reads each reachable node channel's published snapshots (the jax
+        child's ``obs_snapshot`` lane plus the feed tasks' accumulated
+        ``obs_feeder`` lane), merges them with the driver's own registry
+        (reservation timings, client retries), and returns the aggregation
+        plane's snapshot dict with one extra key: ``"nodes"`` maps
+        ``"job:index"`` to that node's own merged view, so per-node detail
+        survives the cluster-level summing of counters/gauges.
+
+        Unreachable channels (NAT'd executors) simply contribute nothing —
+        same degradation story as :meth:`_shutdown_workers`. The result is
+        JSON-able and feeds both exporters directly::
+
+            obs.exporter.MetricsHTTPServer(cluster.metrics, port=9100).start()
+        """
+        snaps = []
+        nodes = {}
+        for row in self._current_rows() or []:
+            if not row.get("manager_addr"):
+                continue
+            try:
+                mgr = TFManager.connect(
+                    tuple(row["manager_addr"]), self.cluster_meta["authkey"]
+                )
+                node_snaps = obs_aggregate.read_channel_snapshots(mgr)
+            except Exception as e:
+                logger.debug(
+                    "metrics: channel %s:%s unreachable: %s",
+                    row["job_name"], row["task_index"], e,
+                )
+                continue
+            if node_snaps:
+                merged_node = obs_aggregate.merge_snapshots(node_snaps)
+                nodes["{}:{}".format(row["job_name"], row["task_index"])] = merged_node
+                snaps.append(merged_node)
+        if include_driver:
+            snaps.append(obs_registry.snapshot())
+        merged = obs_aggregate.merge_snapshots(snaps)
+        merged["nodes"] = nodes
+        return merged
+
 
 def run_with_recovery(
     sc,
@@ -664,6 +709,7 @@ def run(
     eval_node=False,
     env=None,
     jax_distributed=None,
+    obs=None,
 ):
     """Start a cluster: one node per executor (reference TFCluster.py:212-380).
 
@@ -671,7 +717,13 @@ def run(
     ``{"JAX_PLATFORMS": "cpu"}`` for CPU test runs). ``jax_distributed``
     controls whether children join a multi-process jax world; default: only
     when more than one training participant exists and no explicit override.
+    ``obs`` toggles the observability plane cluster-wide (registry collection
+    in children and feed tasks, snapshot publication, ``TFCluster.metrics()``
+    content); default: the driver's ``TOS_OBS`` env setting (on unless
+    ``TOS_OBS=0``).
     """
+    if obs is None:
+        obs = os.environ.get("TOS_OBS", "1") != "0"
     if driver_ps_nodes:
         raise NotImplementedError(
             "driver_ps_nodes: parameter servers have no TPU analogue; ps roles "
@@ -712,6 +764,7 @@ def run(
         # (feed tasks capture it at construction; DataFeed.batch_results
         # reads it from ctx.cluster_meta)
         "feed_shm": TFSparkNode.FEED_SHM,
+        "obs": bool(obs),
     }
 
     tf_status = {}

@@ -29,6 +29,9 @@ import traceback
 
 from tensorflowonspark_tpu import TFManager, TFNode, reservation, tpu_info, util
 from tensorflowonspark_tpu.marker import Chunk, EndPartition
+from tensorflowonspark_tpu.obs import aggregate as obs_aggregate
+from tensorflowonspark_tpu.obs import registry as obs_registry
+from tensorflowonspark_tpu.obs import trace as obs_trace
 
 #: rows per proxied queue message on the feed plane (amortizes the Manager
 #: round trip that was the reference's hot-loop bottleneck; overridable for
@@ -195,7 +198,9 @@ def _child_entry(fn, tf_args, ctx, cluster_meta, error_queue_spec):
     """Entry point of the jax child process: applies env, joins the
     distributed world, runs the user fn; failures land on the 'error' queue
     (reference wrapper_fn_background, TFSparkNode.py:355-361)."""
+    publisher = None
     try:
+        util.setup_logging()  # spawned interpreter: no handlers configured yet
         env = cluster_meta.get("env") or {}
         os.environ.update(env)
         os.environ.update(tpu_info.visibility_env(platform=env.get("JAX_PLATFORMS")))
@@ -208,6 +213,11 @@ def _child_entry(fn, tf_args, ctx, cluster_meta, error_queue_spec):
         addr, authkey = error_queue_spec
         ctx.mgr = TFManager.connect(addr, authkey)
         _start_heartbeat(ctx.mgr)
+        if not cluster_meta.get("obs", True):
+            obs_registry.set_enabled(False)
+        # the long-lived child owns this executor's obs_snapshot lane: its
+        # cumulative registry is overwritten on the channel every interval
+        publisher = obs_aggregate.SnapshotPublisher(ctx.mgr).start()
         if cluster_meta.get("jax_distributed", True):
             ctx.initialize_distributed()
         try:
@@ -225,11 +235,18 @@ def _child_entry(fn, tf_args, ctx, cluster_meta, error_queue_spec):
                 logger.info("jax profiler server on port %d", profiler_port)
             except Exception as e:  # profiling is best-effort
                 logger.warning("could not start jax profiler server: %s", e)
-        fn(tf_args, ctx)
+        with obs_trace.span("node_main", job=ctx.job_name, task_index=ctx.task_index):
+            fn(tf_args, ctx)
+        publisher.stop()  # final flush: short runs publish at least once
         ctx.mgr.set("child_status", "done")
     except BaseException:
         tb = traceback.format_exc()
         logger.error("user main_fun failed:\n%s", tb)
+        try:
+            if publisher is not None:
+                publisher.stop()  # flush so the failed node's metrics survive
+        except Exception:
+            pass
         try:
             addr, authkey = error_queue_spec
             mgr = TFManager.connect(addr, authkey)
@@ -290,6 +307,14 @@ class _NodeLaunchTask:
         if executor_id is None:
             return []
         meta = self.cluster_meta
+        # PRIVATE registry: the executor process outlives this task, and a
+        # relaunch on a reused executor must not double-count the global one
+        # (see obs.aggregate docstring)
+        reg = obs_registry.Registry(enabled=bool(meta.get("obs", True)))
+        states = reg.counter(
+            "node_state_transitions_total",
+            help="node state-machine transitions driven by the launch task",
+        )
 
         # Detect a live node from a previous (failed or duplicate) launch on
         # this executor: raising forces the scheduler to retry elsewhere
@@ -323,6 +348,7 @@ class _NodeLaunchTask:
             _live_channels.pop(key).shutdown()
         _live_channels[executor_id] = mgr  # pin the channel beyond this task
         mgr.set("state", "starting")
+        states.inc()
 
         host = util.get_ip_address()
         port = util.find_free_port()
@@ -336,19 +362,25 @@ class _NodeLaunchTask:
         if meta.get("tensorboard") and is_tb_node:
             tb_port = self._launch_tensorboard(meta.get("log_dir"))
         client = reservation.Client(meta["server_addr"])
-        client.register(
-            {
-                "executor_id": executor_id,
-                "host": host,
-                "job_name": job_name,
-                "task_index": task_index,
-                "port": port,
-                "manager_addr": list(mgr.address),
-                "tb_port": tb_port,
-                "tpu": tpu_info.local_topology(),
-            }
-        )
-        cluster_info = client.await_reservations(timeout=meta.get("reservation_timeout", 600))
+        with obs_trace.span(
+            "node_launch", registry=reg,
+            executor_id=executor_id, job=job_name, task_index=task_index,
+        ):
+            client.register(
+                {
+                    "executor_id": executor_id,
+                    "host": host,
+                    "job_name": job_name,
+                    "task_index": task_index,
+                    "port": port,
+                    "manager_addr": list(mgr.address),
+                    "tb_port": tb_port,
+                    "tpu": tpu_info.local_topology(),
+                }
+            )
+            cluster_info = client.await_reservations(
+                timeout=meta.get("reservation_timeout", 600)
+            )
 
         # sanity: every executor id distinct (reference TFSparkNode.py:281-289)
         ids = [r["executor_id"] for r in cluster_info]
@@ -388,11 +420,12 @@ class _NodeLaunchTask:
             topology=tpu_info.local_topology(),
             cluster_meta={
                 k: meta[k]
-                for k in ("id", "server_addr", "input_mode", "feed_shm")
+                for k in ("id", "server_addr", "input_mode", "feed_shm", "obs")
                 if k in meta
             },
         )
         mgr.set("state", "running")
+        states.inc()
         logger.info(
             "node %s:%d (executor %d) up; world=%s procs=%d id=%d",
             job_name, task_index, executor_id, coord, num_procs, proc_id,
@@ -413,6 +446,14 @@ class _NodeLaunchTask:
         self._register_child(child)
         self._start_abort_watch(mgr, child, job_name, task_index)
 
+        def _flush_obs():
+            # exactly once per return path (accumulate merges, so twice
+            # would double-count); channel failure must not fail the node
+            try:
+                obs_aggregate.accumulate_to_channel(mgr, reg)
+            except Exception:
+                pass
+
         if job_name in ("ps", "evaluator"):
             # park until the driver posts a shutdown message on the control
             # queue (reference ps wait loop, TFSparkNode.py:373-390)
@@ -425,14 +466,18 @@ class _NodeLaunchTask:
             child.terminate()
             child.join(timeout=10)
             mgr.set("state", "stopped")
+            states.inc()
+            _flush_obs()
         elif self.input_mode == "spark":
             # return immediately: this executor's slot is needed for feed tasks
-            pass
+            _flush_obs()
         else:
             # InputMode.TENSORFLOW: the task occupies the slot until training
             # finishes (reference fg-thread dispatch, TFSparkNode.py:391-395)
             child.join()
             mgr.set("state", "stopped")
+            states.inc()
+            _flush_obs()
             if child.exitcode != 0:
                 if mgr.get("abort") is not None:
                     # the driver's abort watcher killed this child on
@@ -639,35 +684,61 @@ class _TrainPartitionTask:
             for _ in iterator:  # drain so the scheduler sees the task consumed
                 pass
             return []
+        # private per-task registry, accumulated onto the channel at task end
+        # (see obs.aggregate docstring for the double-count rationale)
+        reg = obs_registry.Registry(enabled=bool(self.cluster_meta.get("obs", True)))
+        rows_c = reg.counter("feed_rows_total", help="rows fed into the input queue")
+        chunks_c = reg.counter("feed_chunks_total", help="feed-plane chunk messages enqueued")
+        depth_g = reg.gauge(
+            "feed_queue_depth", help="unconsumed input-queue items at last sample"
+        )
         q = mgr.get_queue(self.qname)
         count = 0
         buf = []
-        for item in iterator:
-            buf.append(item)
-            count += 1
-            if len(buf) >= self.chunk_size:
-                _put_rows(q, buf, self.use_shm)
-                buf = []
-        if buf:
-            _put_rows(q, buf, self.use_shm)
-        logger.info("fed %d items to queue %r; waiting for consumption", count, self.qname)
-        deadline = time.time() + self.feed_timeout
-        # fine-grained poll at first (a consumer already caught up finishes
-        # the wait in ~ms, which matters for many small partitions), backing
-        # off so long waits don't hammer the proxy
-        poll = 0.002
-        while q.unfinished() > 0:
-            _raise_if_remote_error(mgr)
-            if mgr.get("state") == "terminating":
-                break
-            if time.time() > deadline:
-                raise RuntimeError(
-                    "feed timeout: queue {!r} still has {} unconsumed items".format(
-                        self.qname, q.unfinished()
-                    )
+        try:
+            with obs_trace.span("feed_wave", registry=reg, qname=self.qname) as sp:
+                for item in iterator:
+                    buf.append(item)
+                    count += 1
+                    if len(buf) >= self.chunk_size:
+                        _put_rows(q, buf, self.use_shm)
+                        rows_c.inc(len(buf))
+                        chunks_c.inc()
+                        buf = []
+                if buf:
+                    _put_rows(q, buf, self.use_shm)
+                    rows_c.inc(len(buf))
+                    chunks_c.inc()
+                sp.set(rows=count)
+                logger.info(
+                    "fed %d items to queue %r; waiting for consumption", count, self.qname
                 )
-            time.sleep(poll)
-            poll = min(poll * 2, 0.1)
+                deadline = time.time() + self.feed_timeout
+                # fine-grained poll at first (a consumer already caught up
+                # finishes the wait in ~ms, which matters for many small
+                # partitions), backing off so long waits don't hammer the proxy
+                poll = 0.002
+                while True:
+                    pending = q.unfinished()
+                    depth_g.set(pending)
+                    if pending <= 0:
+                        break
+                    _raise_if_remote_error(mgr)
+                    if mgr.get("state") == "terminating":
+                        break
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            "feed timeout: queue {!r} still has {} unconsumed items".format(
+                                self.qname, pending
+                            )
+                        )
+                    time.sleep(poll)
+                    poll = min(poll * 2, 0.1)
+        finally:
+            try:  # metrics must surface even when the wave times out
+                obs_aggregate.accumulate_to_channel(mgr, reg)
+            except Exception:
+                pass
         _raise_if_remote_error(mgr)
         if mgr.get("state") == "terminating":
             # training said "enough" (e.g. reached target steps): tell the
@@ -701,41 +772,62 @@ class _InferencePartitionTask:
 
     def __call__(self, iterator):
         _state, mgr = _connect_executor_channel()
+        reg = obs_registry.Registry(enabled=bool(self.cluster_meta.get("obs", True)))
+        rows_c = reg.counter("feed_rows_total", help="rows fed into the input queue")
+        chunks_c = reg.counter("feed_chunks_total", help="feed-plane chunk messages enqueued")
+        results_c = reg.counter(
+            "inference_results_total", help="inference results collected back from nodes"
+        )
         q = mgr.get_queue(self.qname_in)
         count = 0
         buf = []
-        for item in iterator:
-            buf.append(item)
-            count += 1
-            if len(buf) >= self.chunk_size:
-                _put_rows(q, buf, self.use_shm)
-                buf = []
-        if buf:
-            _put_rows(q, buf, self.use_shm)
-        q.put(EndPartition(), block=True)
-        if count == 0:
-            return []
-        deadline = time.time() + self.feed_timeout
-        poll = 0.002
-        while q.unfinished() > 0:
-            _raise_if_remote_error(mgr)
-            if time.time() > deadline:
-                raise RuntimeError("inference feed timeout on queue {!r}".format(self.qname_in))
-            time.sleep(poll)
-            poll = min(poll * 2, 0.1)
-        from tensorflowonspark_tpu.shm import ShmChunk
+        try:
+            with obs_trace.span("inference_wave", registry=reg, qname=self.qname_in) as sp:
+                for item in iterator:
+                    buf.append(item)
+                    count += 1
+                    if len(buf) >= self.chunk_size:
+                        _put_rows(q, buf, self.use_shm)
+                        rows_c.inc(len(buf))
+                        chunks_c.inc()
+                        buf = []
+                if buf:
+                    _put_rows(q, buf, self.use_shm)
+                    rows_c.inc(len(buf))
+                    chunks_c.inc()
+                q.put(EndPartition(), block=True)
+                sp.set(rows=count)
+                if count == 0:
+                    return []
+                deadline = time.time() + self.feed_timeout
+                poll = 0.002
+                while q.unfinished() > 0:
+                    _raise_if_remote_error(mgr)
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            "inference feed timeout on queue {!r}".format(self.qname_in)
+                        )
+                    time.sleep(poll)
+                    poll = min(poll * 2, 0.1)
+                from tensorflowonspark_tpu.shm import ShmChunk
 
-        out = mgr.get_queue(self.qname_out)
-        results = []
-        while len(results) < count:
-            item = out.get(block=True, timeout=self.feed_timeout)
-            out.task_done()
-            if isinstance(item, ShmChunk):
-                results.extend(item.rows())
-            elif isinstance(item, Chunk):
-                results.extend(item.items)
-            else:
-                results.append(item)
+                out = mgr.get_queue(self.qname_out)
+                results = []
+                while len(results) < count:
+                    item = out.get(block=True, timeout=self.feed_timeout)
+                    out.task_done()
+                    if isinstance(item, ShmChunk):
+                        results.extend(item.rows())
+                    elif isinstance(item, Chunk):
+                        results.extend(item.items)
+                    else:
+                        results.append(item)
+                results_c.inc(len(results))
+        finally:
+            try:
+                obs_aggregate.accumulate_to_channel(mgr, reg)
+            except Exception:
+                pass
         if len(results) > count:
             raise RuntimeError(
                 "collected {} inference results for a {}-item partition: "

@@ -25,16 +25,12 @@ with minimal changes, while every implementation is TPU-first:
 * :mod:`~tensorflowonspark_tpu.models` — flax model zoo (mnist, resnet, segmentation, transformer).
 * :mod:`~tensorflowonspark_tpu.backends` — Spark and local multi-process execution backends.
 
-Logging format carries process/thread like the reference
-(/root/reference/tensorflowonspark/__init__.py:3) because the runtime spans a
-driver, N executor processes and N jax child processes.
+Importing this package configures NO logging: applications opt in with
+:func:`tensorflowonspark_tpu.util.setup_logging` (examples and bench.py call
+it; the jax child process calls it on entry). The format carries
+process/thread like the reference (/root/reference/tensorflowonspark/__init__.py:3)
+because the runtime spans a driver, N executor processes and N jax child
+processes.
 """
-
-import logging
-
-logging.basicConfig(
-    level=logging.INFO,
-    format="%(asctime)s %(levelname)s (%(processName)s %(threadName)s) %(name)s: %(message)s",
-)
 
 __version__ = "0.1.0"

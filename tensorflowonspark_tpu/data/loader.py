@@ -16,6 +16,8 @@ import threading
 
 import numpy as np
 
+from tensorflowonspark_tpu import obs
+
 logger = logging.getLogger(__name__)
 
 
@@ -108,6 +110,15 @@ class ImagePipeline:
         out_q = queue.Queue(maxsize=max(1, self.prefetch_batches))
         stop = threading.Event()
         _END = object()
+        produced_c = obs.counter(
+            "data_batches_produced_total", help="batches parsed by the input pipeline"
+        )
+        consumed_c = obs.counter(
+            "data_batches_consumed_total", help="batches handed to the training loop"
+        )
+        depth_g = obs.gauge(
+            "data_prefetch_depth", help="parsed batches waiting in the prefetch queue"
+        )
 
         def _final_put(item):
             # never block forever on a departed consumer: its finally drains
@@ -130,6 +141,8 @@ class ImagePipeline:
                     images = images.astype(np.float32)
                 labels = np.asarray([p[1] for p in parsed], np.int32)
                 out_q.put({"image": images, "label": labels})
+                produced_c.inc()
+                depth_g.set(out_q.qsize())
 
             try:
                 with ThreadPoolExecutor(self.num_threads) as pool:
@@ -159,6 +172,8 @@ class ImagePipeline:
                     return
                 if isinstance(item, BaseException):
                     raise item
+                consumed_c.inc()
+                depth_g.set(out_q.qsize())
                 yield item
         finally:
             stop.set()

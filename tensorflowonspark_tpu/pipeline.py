@@ -453,9 +453,11 @@ class TFEstimator(TFParams, HasBatchSize, HasClusterSize, HasEpochs, HasGraceSec
     chief when feeding ends.
     """
 
-    def __init__(self, train_fn, tf_args=None, export_fn=None, env=None, jax_distributed=None):
-        """``env``/``jax_distributed`` forward to ``TFCluster.run`` (e.g.
-        ``env={"JAX_PLATFORMS": "cpu"}`` for CPU clusters)."""
+    def __init__(self, train_fn, tf_args=None, export_fn=None, env=None, jax_distributed=None,
+                 obs=None):
+        """``env``/``jax_distributed``/``obs`` forward to ``TFCluster.run``
+        (e.g. ``env={"JAX_PLATFORMS": "cpu"}`` for CPU clusters; ``obs=False``
+        turns the observability plane off for this estimator's clusters)."""
         # cooperative super: every Has* mixin sets its defaults, Params (the
         # MRO root before object) creates the maps first
         super().__init__()
@@ -463,6 +465,10 @@ class TFEstimator(TFParams, HasBatchSize, HasClusterSize, HasEpochs, HasGraceSec
         self.export_fn = export_fn
         self.env = env
         self.jax_distributed = jax_distributed
+        self.obs = obs
+        #: merged cluster metrics snapshot captured at the end of the last
+        #: ``fit`` (before shutdown); None until a fit completes
+        self.cluster_metrics_ = None
         self.args = Namespace(tf_args) if tf_args is not None else Namespace({})
 
     def fit(self, dataset, params=None):
@@ -532,9 +538,15 @@ class TFEstimator(TFParams, HasBatchSize, HasClusterSize, HasEpochs, HasGraceSec
             sc, self.train_fn, args, args.cluster_size, num_ps=args.num_ps,
             tensorboard=args.tensorboard, input_mode=TFCluster.InputMode.SPARK,
             master_node=args.master_node, driver_ps_nodes=args.driver_ps_nodes,
-            env=env or None, jax_distributed=jax_distributed,
+            env=env or None, jax_distributed=jax_distributed, obs=self.obs,
         )
         cluster.train(dataset.select(input_cols).rdd, args.epochs)
+        try:
+            # capture while node channels are still up — after shutdown the
+            # executor managers (and their published snapshots) are gone
+            self.cluster_metrics_ = cluster.metrics()
+        except Exception as e:
+            logger.debug("could not capture cluster metrics: %s", e)
         cluster.shutdown(grace_secs=args.grace_secs)
 
         model = TFModel(self.args)

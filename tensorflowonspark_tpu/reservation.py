@@ -31,6 +31,8 @@ import struct
 import threading
 import time
 
+from tensorflowonspark_tpu import obs
+
 logger = logging.getLogger(__name__)
 
 #: env var: externally-visible host for the server (NAT / container setups)
@@ -233,19 +235,30 @@ class Server:
         immediately in that case (reference reservation.py:113-126 +
         TFCluster.py:314-331).
         """
+        pending = obs.gauge(
+            "reservation_pending_nodes", help="nodes still missing from the cluster"
+        )
         deadline = time.time() + timeout
-        while not self.reservations.done:
-            if status and status.get("error"):
-                raise ReservationError(
-                    "cluster startup aborted by node failure: {}".format(status["error"])
-                )
-            if time.time() > deadline:
-                raise ReservationError(
-                    "timed out waiting for {} node(s) to register (of {})".format(
-                        self.reservations.remaining(), self.reservations.required
+        with obs.span("reservation_roundtrip", required=self.reservations.required):
+            while not self.reservations.done:
+                pending.set(self.reservations.remaining())
+                if status and status.get("error"):
+                    obs.counter(
+                        "reservation_failures_total",
+                        help="await_reservations aborts (node error or timeout)",
+                    ).inc()
+                    raise ReservationError(
+                        "cluster startup aborted by node failure: {}".format(status["error"])
                     )
-                )
-            self.reservations.wait(timeout=poll_interval)
+                if time.time() > deadline:
+                    obs.counter("reservation_failures_total").inc()
+                    raise ReservationError(
+                        "timed out waiting for {} node(s) to register (of {})".format(
+                            self.reservations.remaining(), self.reservations.required
+                        )
+                    )
+                self.reservations.wait(timeout=poll_interval)
+        pending.set(0)
         logger.info(
             "all %d node(s) reserved", self.reservations.required
         )
@@ -302,6 +315,10 @@ class Server:
         kind = msg.get("type") if isinstance(msg, dict) else None
         if kind == "REG":
             self.reservations.add(msg.get("data", {}))
+            obs.counter(
+                "reservation_registrations_total",
+                help="REG messages accepted (retries re-register idempotently)",
+            ).inc()
             msock.send({"type": "OK"})
         elif kind == "QUERY":
             msock.send({"type": "DONE", "data": self.reservations.done})
@@ -346,6 +363,10 @@ class Client:
                     return reply
             except (OSError, ReservationError) as e:
                 last_err = e
+                obs.counter(
+                    "reservation_client_retries_total",
+                    help="control-plane request attempts that failed and retried",
+                ).inc()
                 if attempt < self.RETRIES - 1:
                     time.sleep(min(2 ** attempt, 5))
         raise ReservationError(

@@ -55,6 +55,7 @@ import queue
 import socket
 import threading
 
+from tensorflowonspark_tpu import obs
 from tensorflowonspark_tpu.reservation import MessageSocket
 
 logger = logging.getLogger(__name__)
@@ -147,6 +148,21 @@ class _Predictor:
         self._backlog = collections.deque()
         self._stopped = False
         self._submit_lock = threading.Lock()
+        self._requests_c = obs.counter(
+            "serving_requests_total", help="predict requests submitted (shed ones included)"
+        )
+        self._shed_over_c = obs.counter(
+            "serving_shed_overloaded_total", help="requests shed: pending queue full"
+        )
+        self._shed_deadline_c = obs.counter(
+            "serving_shed_deadline_total", help="requests shed: queued past their deadline"
+        )
+        self._pending_g = obs.gauge(
+            "serving_pending_depth", help="requests pending (queue + deferred backlog)"
+        )
+        self._latency_h = obs.histogram(
+            "serving_request_seconds", help="end-to-end predict latency, submit to result"
+        )
         self._thread = threading.Thread(target=self._run, name="tos-predictor", daemon=True)
         self._thread.start()
 
@@ -162,6 +178,7 @@ class _Predictor:
         import numpy as np
         from concurrent.futures import Future
 
+        self._requests_c.inc()
         if not arrays:
             raise ValueError("predict requires at least one input column")
         lead = set()
@@ -191,14 +208,18 @@ class _Predictor:
             # a slow model can park the entire load there — a qsize()-only
             # gate would never fire. Both reads are exact enough under the
             # lock (the only other mutator is the single consumer thread).
-            if self._q.qsize() + len(self._backlog) >= self._max_pending:
+            pending = self._q.qsize() + len(self._backlog)
+            self._pending_g.set(pending)
+            if pending >= self._max_pending:
+                self._shed_over_c.inc()
                 raise Overloaded(
                     "server overloaded: {} requests pending; request shed".format(
                         self._max_pending
                     )
                 )
             self._q.put((arrays, fut, deadline))
-        return fut.result()
+        with self._latency_h.time():
+            return fut.result()
 
     def stop(self):
         with self._submit_lock:
@@ -246,6 +267,7 @@ class _Predictor:
         import time as _time
 
         if item[2] is not None and _time.monotonic() > item[2]:
+            self._shed_deadline_c.inc()
             item[1].set_exception(
                 DeadlineExceeded(
                     "request shed: queued past its {:.0f} ms deadline".format(
@@ -320,6 +342,7 @@ class _Predictor:
                 _admit(nxt)
             # deferred items are older than anything left in the backlog
             self._backlog.extendleft(reversed(deferred))
+            self._pending_g.set(self._q.qsize() + len(self._backlog))
 
             try:
                 if len(batch) == 1:
@@ -736,6 +759,10 @@ def main(argv=None):
     serve_p.add_argument("--host", default="")
     serve_p.add_argument("--port", type=int, default=8500)
     serve_p.add_argument(
+        "--metrics_port", type=int, default=0, metavar="PORT",
+        help="serve Prometheus metrics (GET /metrics) and the raw snapshot "
+             "(GET /metrics.json) on this port; 0 (default) disables the endpoint")
+    serve_p.add_argument(
         "--trusted_builder", default=None, metavar="MODULE:ATTR",
         help="take the predict-fn builder from your own code instead of the "
              "bundle's pickle; with npz weights, nothing from --export_dir "
@@ -758,7 +785,9 @@ def main(argv=None):
                          help="safe-load lane for --export_dir (see serve --help)")
 
     args = parser.parse_args(argv)
-    logging.basicConfig(level=logging.INFO)
+    from tensorflowonspark_tpu import util
+
+    util.setup_logging()
 
     if args.command == "infer":
         if args.server is None and args.export_dir is None:
@@ -785,10 +814,29 @@ def main(argv=None):
         args.export_dir, args.host, args.port, trusted_builder=args.trusted_builder
     )
     host, port = server.start()
-    print(json.dumps({"serving": args.export_dir, "host": host or "0.0.0.0", "port": port}), flush=True)
+    metrics_server = None
+    if args.metrics_port:
+        from tensorflowonspark_tpu.obs import exporter
+
+        metrics_server = exporter.MetricsHTTPServer(
+            obs.snapshot, host=args.host, port=args.metrics_port
+        ).start()
+    print(
+        json.dumps(
+            {
+                "serving": args.export_dir,
+                "host": host or "0.0.0.0",
+                "port": port,
+                "metrics_port": metrics_server.address[1] if metrics_server else None,
+            }
+        ),
+        flush=True,
+    )
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
+        if metrics_server is not None:
+            metrics_server.stop()
         server.stop()
 
 

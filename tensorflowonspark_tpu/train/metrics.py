@@ -9,6 +9,8 @@ formula :241-244); here it is a framework module any training loop can use.
 import logging
 import time
 
+from tensorflowonspark_tpu import obs
+
 logger = logging.getLogger(__name__)
 
 
@@ -36,29 +38,33 @@ class TimeHistory:
         self.global_steps = 0
         self.timestamps = []  # [(interval_start, interval_end), ...]
         self._interval_start = None
+        # publish into the process registry: the jax child's SnapshotPublisher
+        # ships these to the driver's TFCluster.metrics() view
+        self._steps_c = obs.counter("train_steps_total", help="completed training steps")
+        self._rate_g = obs.gauge(
+            "train_examples_per_sec", help="throughput over the last completed log interval"
+        )
 
     def batch_end(self):
         now = time.time()
         if self._interval_start is None:
             self._interval_start = now
         self.global_steps += 1
+        self._steps_c.inc()
         if self.global_steps % self.log_steps == 0:
             self.timestamps.append((self._interval_start, now))
             # per-interval rate needs >=2 log points within the interval;
             # log_steps=1 rates come from consecutive interval ends instead
             if self.log_steps > 1 and now > self._interval_start:
-                logger.info(
-                    "step %d: %.1f examples/sec",
-                    self.global_steps,
-                    self.batch_size * (self.log_steps - 1) / (now - self._interval_start),
-                )
+                rate = self.batch_size * (self.log_steps - 1) / (now - self._interval_start)
+                self._rate_g.set(rate)
+                logger.info("step %d: %.1f examples/sec", self.global_steps, rate)
             elif self.log_steps == 1 and len(self.timestamps) >= 2:
                 prev_end = self.timestamps[-2][1]
                 if now > prev_end:
-                    logger.info(
-                        "step %d: %.1f examples/sec",
-                        self.global_steps, self.batch_size / (now - prev_end),
-                    )
+                    rate = self.batch_size / (now - prev_end)
+                    self._rate_g.set(rate)
+                    logger.info("step %d: %.1f examples/sec", self.global_steps, rate)
             self._interval_start = None
 
     @property

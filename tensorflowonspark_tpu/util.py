@@ -19,6 +19,21 @@ logger = logging.getLogger(__name__)
 
 _mp_spawn = multiprocessing.get_context("spawn")
 
+#: log format carrying process/thread names — the runtime spans a driver,
+#: N executor processes and N jax child processes, so bare messages are
+#: un-attributable (reference tensorflowonspark/__init__.py:3)
+LOG_FORMAT = "%(asctime)s %(levelname)s (%(processName)s %(threadName)s) %(name)s: %(message)s"
+
+
+def setup_logging(level=logging.INFO):
+    """Configure root logging for an APPLICATION entry point (examples,
+    bench.py, the jax child process). Libraries must never do this at import
+    time — importing :mod:`tensorflowonspark_tpu` leaves the root logger's
+    handlers untouched so embedding applications keep control of their own
+    logging (enforced by scripts/check_no_basicconfig.py and a regression
+    test). No-op if the root logger is already configured."""
+    logging.basicConfig(level=level, format=LOG_FORMAT)
+
 
 def _spawn_trampoline(blob):
     import cloudpickle

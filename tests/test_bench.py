@@ -1,0 +1,26 @@
+"""Unit tests for bench.py's result-annotation helpers (the heavy benchmark
+paths themselves run under BENCH_* env switches, not pytest)."""
+
+import importlib.util
+import os
+
+_BENCH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+_spec = importlib.util.spec_from_file_location("bench", _BENCH)
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def test_confidence_fields_full_budget():
+    # all requested pairs recorded: no low-confidence flag in the JSON
+    assert bench.confidence_fields(6, 6) == {"pairs": 6}
+    assert bench.confidence_fields(7, 6) == {"pairs": 7}
+
+
+def test_confidence_fields_budget_exhausted():
+    out = bench.confidence_fields(3, 6)
+    assert out == {"pairs": 3, "low_confidence": True}
+
+
+def test_confidence_fields_zero_pairs():
+    out = bench.confidence_fields(0, 6)
+    assert out["pairs"] == 0 and out["low_confidence"] is True

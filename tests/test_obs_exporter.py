@@ -1,0 +1,166 @@
+"""Parser-level validation of the Prometheus exposition output + the HTTP
+endpoint. The parser below implements the text-format 0.0.4 grammar the repo
+emits (HELP/TYPE comment lines, `name{labels} value` samples) so the tests
+fail on any malformed line, not just on missing substrings."""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from tensorflowonspark_tpu.obs import exporter
+from tensorflowonspark_tpu.obs.registry import Registry
+
+SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>[^ ]+)$"
+)
+HELP_RE = re.compile(r"^# HELP (?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*) (?P<text>.*)$")
+TYPE_RE = re.compile(
+    r"^# TYPE (?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*) (?P<kind>counter|gauge|histogram|summary|untyped)$"
+)
+
+
+def parse_exposition(text):
+    """Parse exposition text into {family: {"type","help","samples":[(name, labels, value)]}}.
+    Raises AssertionError on any line that is not valid format 0.0.4."""
+    assert text.endswith("\n"), "exposition text must end with a newline"
+    families = {}
+    current = None
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        m = HELP_RE.match(line)
+        if m:
+            families.setdefault(m.group("name"), {"samples": []})["help"] = m.group("text")
+            continue
+        m = TYPE_RE.match(line)
+        if m:
+            fam = families.setdefault(m.group("name"), {"samples": []})
+            fam["type"] = m.group("kind")
+            current = m.group("name")
+            continue
+        assert not line.startswith("#"), "unrecognized comment line: {!r}".format(line)
+        m = SAMPLE_RE.match(line)
+        assert m, "malformed sample line: {!r}".format(line)
+        name, labels_raw, value = m.group("name", "labels", "value")
+        labels = {}
+        if labels_raw:
+            for pair in labels_raw.split(","):
+                lm = re.match(r'^([a-zA-Z_][a-zA-Z0-9_]*)="(.*)"$', pair)
+                assert lm, "malformed label pair: {!r}".format(pair)
+                labels[lm.group(1)] = lm.group(2)
+        if value == "+Inf":
+            val = float("inf")
+        else:
+            val = float(value)
+        # samples belong to the family whose name is a prefix (histogram
+        # children are name_bucket/name_sum/name_count)
+        fam_name = current if current and name.startswith(current) else name
+        families.setdefault(fam_name, {"samples": []})["samples"].append((name, labels, val))
+    return families
+
+
+@pytest.fixture
+def snap():
+    reg = Registry()
+    reg.counter("requests_total", help="total requests").inc(3)
+    reg.gauge("queue_depth", help="pending").set(2.5)
+    h = reg.histogram("latency_seconds", help="latency", buckets=(0.1, 0.5, 1.0))
+    for v in (0.05, 0.05, 0.3, 2.0):
+        h.observe(v)
+    return reg.snapshot()
+
+
+def test_counter_and_gauge_render(snap):
+    fams = parse_exposition(exporter.render_prometheus(snap))
+    assert fams["requests_total"]["type"] == "counter"
+    assert fams["requests_total"]["help"] == "total requests"
+    assert fams["requests_total"]["samples"] == [("requests_total", {}, 3.0)]
+    assert fams["queue_depth"]["type"] == "gauge"
+    assert fams["queue_depth"]["samples"] == [("queue_depth", {}, 2.5)]
+
+
+def test_histogram_buckets_are_cumulative_and_inf_equals_count(snap):
+    fams = parse_exposition(exporter.render_prometheus(snap))
+    fam = fams["latency_seconds"]
+    assert fam["type"] == "histogram"
+    buckets = {s[1]["le"]: s[2] for s in fam["samples"] if s[0] == "latency_seconds_bucket"}
+    # non-cumulative input was [2, 1, 0]; output must be cumulative
+    assert buckets == {"0.1": 2.0, "0.5": 3.0, "1": 3.0, "+Inf": 4.0}
+    # cumulative monotone, +Inf == _count sample
+    count = [s for s in fam["samples"] if s[0] == "latency_seconds_count"][0][2]
+    assert buckets["+Inf"] == count == 4.0
+    total = [s for s in fam["samples"] if s[0] == "latency_seconds_sum"][0][2]
+    assert total == pytest.approx(2.4)
+
+
+def test_every_sample_line_is_well_formed(snap):
+    # parse_exposition asserts line-by-line; a malformed line raises
+    fams = parse_exposition(exporter.render_prometheus(snap))
+    for fam in fams.values():
+        assert "type" in fam, "sample emitted without a TYPE header"
+
+
+def test_metric_names_are_sanitized():
+    snap = {"counters": {"bad-name.with spaces": {"value": 1, "help": ""}}}
+    text = exporter.render_prometheus(snap)
+    fams = parse_exposition(text)
+    assert "bad_name_with_spaces" in fams
+
+
+def test_help_text_escapes_newlines():
+    snap = {"counters": {"c": {"value": 1, "help": "line1\nline2"}}}
+    text = exporter.render_prometheus(snap)
+    parse_exposition(text)  # still one HELP line, still parseable
+    assert "# HELP c line1\\nline2" in text
+
+
+def test_integer_values_render_bare():
+    snap = {"counters": {"c": {"value": 5.0, "help": ""}}}
+    assert "c 5\n" in exporter.render_prometheus(snap)
+
+
+def test_render_json_round_trips(snap):
+    assert json.loads(exporter.render_json(snap)) == json.loads(json.dumps(snap))
+
+
+def test_http_server_serves_metrics_and_json():
+    reg = Registry()
+    reg.counter("hits_total").inc(2)
+    srv = exporter.MetricsHTTPServer(reg.snapshot, host="127.0.0.1", port=0).start()
+    try:
+        base = "http://127.0.0.1:{}".format(srv.address[1])
+        resp = urllib.request.urlopen(base + "/metrics", timeout=10)
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == exporter.CONTENT_TYPE
+        fams = parse_exposition(resp.read().decode("utf-8"))
+        assert fams["hits_total"]["samples"] == [("hits_total", {}, 2.0)]
+
+        resp = urllib.request.urlopen(base + "/metrics.json", timeout=10)
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == "application/json"
+        snap = json.loads(resp.read().decode("utf-8"))
+        assert snap["counters"]["hits_total"]["value"] == 2
+
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(base + "/nope", timeout=10)
+        assert exc_info.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_http_server_survives_broken_snapshot_fn():
+    def broken():
+        raise RuntimeError("snapshot exploded")
+
+    srv = exporter.MetricsHTTPServer(broken, host="127.0.0.1", port=0).start()
+    try:
+        base = "http://127.0.0.1:{}".format(srv.address[1])
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(base + "/metrics", timeout=10)
+        assert exc_info.value.code == 500
+    finally:
+        srv.stop()

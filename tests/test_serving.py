@@ -588,6 +588,10 @@ def test_overload_sheds_with_error():
         return {"y": arrays["x"].sum(axis=1, keepdims=True)}
 
     pred = _Predictor(slow_fn, None, None, max_pending=2)
+    # obs counters are process-global and cumulative across tests: take deltas
+    requests_before = pred._requests_c.value
+    shed_before = pred._shed_over_c.value
+    latency_before = pred._latency_h.count
     try:
         results, errors = [], []
 
@@ -614,6 +618,10 @@ def test_overload_sheds_with_error():
             t.join(timeout=60)
         assert not errors, errors
         assert len(results) == 3  # everything accepted was served
+        # metrics saw what happened: 4 submits, 1 shed, 3 latencies observed
+        assert pred._requests_c.value - requests_before == 4
+        assert pred._shed_over_c.value - shed_before == 1
+        assert pred._latency_h.count - latency_before == 3
     finally:
         release.set()
         pred.stop()
@@ -635,6 +643,7 @@ def test_deadline_sheds_stale_queued_requests():
         return {"y": arrays["x"].sum(axis=1, keepdims=True)}
 
     pred = _Predictor(slow_fn, None, None, deadline_ms=200)
+    shed_before = pred._shed_deadline_c.value
     try:
         results, errors = [], []
 
@@ -655,6 +664,7 @@ def test_deadline_sheds_stale_queued_requests():
         t1.join(timeout=60)
         assert len(results) == 1  # the in-flight one completed
         assert len(errors) == 1 and isinstance(errors[0], DeadlineExceeded), errors
+        assert pred._shed_deadline_c.value - shed_before == 1
     finally:
         release.set()
         pred.stop()

@@ -1,6 +1,55 @@
 import os
+import subprocess
+import sys
 
 from tensorflowonspark_tpu import util
+
+
+def test_import_configures_no_logging():
+    """Importing the library must not touch the root logger (the import-time
+    basicConfig this repo used to ship hijacked logging from every host
+    application). Run in a fresh interpreter: this process imported the
+    package long ago."""
+    code = (
+        "import logging\n"
+        "before = list(logging.getLogger().handlers)\n"
+        "level = logging.getLogger().level\n"
+        "import tensorflowonspark_tpu\n"
+        "import tensorflowonspark_tpu.util\n"
+        "assert list(logging.getLogger().handlers) == before, 'import added handlers'\n"
+        "assert logging.getLogger().level == level, 'import changed root level'\n"
+        "print('clean')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "clean" in out.stdout
+
+
+def test_setup_logging_configures_root():
+    # basicConfig is a no-op on an already-configured root, so check in a
+    # subprocess where the root is pristine
+    code = (
+        "import logging\n"
+        "from tensorflowonspark_tpu import util\n"
+        "util.setup_logging(level=logging.DEBUG)\n"
+        "root = logging.getLogger()\n"
+        "assert root.level == logging.DEBUG\n"
+        "assert root.handlers, 'setup_logging installed no handler'\n"
+        "fmt = root.handlers[0].formatter._fmt\n"
+        "assert fmt == util.LOG_FORMAT, fmt\n"
+        "print('configured')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert out.returncode == 0, out.stderr
+    assert "configured" in out.stdout
 
 
 def test_ip_address_is_string():
