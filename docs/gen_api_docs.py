@@ -38,6 +38,8 @@ MODULES = [
     "tensorflowonspark_tpu.serving",
     "tensorflowonspark_tpu.compat",
     "tensorflowonspark_tpu.util",
+    "tensorflowonspark_tpu.resilience",
+    "tensorflowonspark_tpu.chaos",
     "tensorflowonspark_tpu.obs",
     "tensorflowonspark_tpu.obs.registry",
     "tensorflowonspark_tpu.obs.aggregate",

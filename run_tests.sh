@@ -7,14 +7,20 @@
 # With pyspark installed: additionally boots a local-cluster master so the
 # integration tests can target real Spark executors.
 #
-# Usage: ./run_tests.sh [--quick] [extra pytest args]
+# Usage: ./run_tests.sh [--quick] [--chaos] [extra pytest args]
 #   --quick  run the quick tier only (pytest -m 'not slow')
+#   --chaos  run the quick tier under a fixed low-probability ChaosPlan and
+#            assert that at least one fault was actually injected
 set -euo pipefail
 cd "$(dirname "$0")"
 
+CHAOS=0
 EXTRA=()
 for arg in "$@"; do
   if [[ "$arg" == "--quick" ]]; then
+    EXTRA+=(-m "not slow")
+  elif [[ "$arg" == "--chaos" ]]; then
+    CHAOS=1
     EXTRA+=(-m "not slow")
   else
     EXTRA+=("$arg")
@@ -35,6 +41,28 @@ if python -c "import pyspark" 2>/dev/null; then
   export MASTER="local-cluster[2,1,1024]"
 else
   echo "pyspark not installed: using the bundled local multi-process backend"
+fi
+
+if [[ "$CHAOS" == "1" ]]; then
+  # Benign (delay-only) sites at low probability: the suite's assertions
+  # must keep passing — chaos here perturbs timing, not outcomes. Error
+  # faults get exercised deterministically by tests/test_chaos_*.py.
+  export TOS_CHAOS_PLAN='{"seed": 2024, "sites": {
+    "feed.stall":           {"probability": 0.02, "max_count": null, "delay_s": 0.01},
+    "feed.slow_consumer":   {"probability": 0.02, "max_count": null, "delay_s": 0.01},
+    "data.producer_delay":  {"probability": 0.05, "max_count": null, "delay_s": 0.01},
+    "serving.latency":      {"probability": 0.05, "max_count": null, "delay_s": 0.01},
+    "reservation.slow_accept": {"probability": 0.05, "max_count": null, "delay_s": 0.01}
+  }}'
+  export TOS_CHAOS_LOG="$(mktemp /tmp/tos_chaos_log.XXXXXX)"
+  echo "chaos leg: plan active, fault log at $TOS_CHAOS_LOG"
+  python -m pytest tests/ -q ${EXTRA[@]+"${EXTRA[@]}"}
+  if [[ ! -s "$TOS_CHAOS_LOG" ]]; then
+    echo "chaos leg FAILED: no faults were injected (empty $TOS_CHAOS_LOG)" >&2
+    exit 1
+  fi
+  echo "chaos leg: $(wc -l < "$TOS_CHAOS_LOG") fault(s) injected"
+  exit 0
 fi
 
 exec python -m pytest tests/ -q ${EXTRA[@]+"${EXTRA[@]}"}

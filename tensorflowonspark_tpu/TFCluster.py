@@ -23,7 +23,7 @@ import secrets
 import threading
 import time as _time
 
-from tensorflowonspark_tpu import TFSparkNode, TFManager, reservation
+from tensorflowonspark_tpu import TFSparkNode, TFManager, chaos, reservation
 from tensorflowonspark_tpu.obs import aggregate as obs_aggregate
 from tensorflowonspark_tpu.obs import registry as obs_registry
 
@@ -756,7 +756,14 @@ def run(
         "input_mode": "spark" if input_mode == InputMode.SPARK else "tensorflow",
         "authkey": secrets.token_bytes(16),
         "reservation_timeout": reservation_timeout,
-        "env": dict(env or {}),
+        # a driver-installed chaos plan rides the env lane so executors /
+        # jax children on OTHER hosts (no shared os.environ) inherit it;
+        # an explicit user-provided TOS_CHAOS_PLAN in env wins
+        "env": (
+            {chaos.ENV_VAR: chaos.plan().to_json(), **dict(env or {})}
+            if chaos.active
+            else dict(env or {})
+        ),
         "jax_distributed": bool(jax_distributed),
         "tensorboard": bool(tensorboard),
         "log_dir": log_dir,

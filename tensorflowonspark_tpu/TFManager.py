@@ -19,6 +19,8 @@ import queue
 import threading
 from multiprocessing.managers import BaseManager
 
+from tensorflowonspark_tpu import chaos
+
 logger = logging.getLogger(__name__)
 
 #: queue names created by default for worker nodes
@@ -122,6 +124,8 @@ class QueueView:
         self._name = name
 
     def put(self, item, block=True, timeout=None):
+        if chaos.active:
+            chaos.delay("feed.stall")
         self._channel.put(self._name, item, block, timeout)
 
     def get(self, block=True, timeout=None):

@@ -17,6 +17,7 @@ import collections
 import getpass
 import logging
 
+from tensorflowonspark_tpu import chaos
 from tensorflowonspark_tpu.marker import Chunk, EndPartition
 
 logger = logging.getLogger(__name__)
@@ -194,6 +195,8 @@ class DataFeed:
         path from feeder numpy straight to ``jax.device_put``.
         """
         logger.debug("next_batch(%d)", batch_size)
+        if chaos.active:
+            chaos.delay("feed.slow_consumer")
         queue_in = self.mgr.get_queue(self.qname_in)
         tensors = [] if self.input_tensors is None else {t: [] for t in self.input_tensors}
         count = 0

@@ -27,7 +27,7 @@ import os
 import time
 import traceback
 
-from tensorflowonspark_tpu import TFManager, TFNode, reservation, tpu_info, util
+from tensorflowonspark_tpu import TFManager, TFNode, chaos, reservation, tpu_info, util
 from tensorflowonspark_tpu.marker import Chunk, EndPartition
 from tensorflowonspark_tpu.obs import aggregate as obs_aggregate
 from tensorflowonspark_tpu.obs import registry as obs_registry
@@ -203,6 +203,10 @@ def _child_entry(fn, tf_args, ctx, cluster_meta, error_queue_spec):
         util.setup_logging()  # spawned interpreter: no handlers configured yet
         env = cluster_meta.get("env") or {}
         os.environ.update(env)
+        # the env lane can carry a chaos plan for cross-host executors, but
+        # the chaos module already ran its import-time env check in this
+        # interpreter — re-check now that the lane has landed
+        chaos._install_from_env()
         os.environ.update(tpu_info.visibility_env(platform=env.get("JAX_PLATFORMS")))
         if env.get("JAX_PLATFORMS"):
             # config-API forcing: on TPU-pod images the site setup pins the
@@ -664,6 +668,15 @@ def _raise_if_remote_error(mgr):
         raise RuntimeError("error in jax child process:\n{}".format(tb))
 
 
+def _chaos_trim(buf):
+    """Chaos fault ``feed.truncate_chunk``: drop the tail of one train chunk
+    (a torn feed message). Train-only — inference feeds keep their 1:1
+    row/output contract, so this is called from the train feeder alone."""
+    if chaos.fire("feed.truncate_chunk"):
+        return buf[: max(1, len(buf) // 2)]
+    return buf
+
+
 class _TrainPartitionTask:
     """Feeds one RDD partition into the executor's input queue
     (reference ``TFSparkNode.train()._train``, TFSparkNode.py:400-467)."""
@@ -701,11 +714,15 @@ class _TrainPartitionTask:
                     buf.append(item)
                     count += 1
                     if len(buf) >= self.chunk_size:
+                        if chaos.active:
+                            buf = _chaos_trim(buf)
                         _put_rows(q, buf, self.use_shm)
                         rows_c.inc(len(buf))
                         chunks_c.inc()
                         buf = []
                 if buf:
+                    if chaos.active:
+                        buf = _chaos_trim(buf)
                     _put_rows(q, buf, self.use_shm)
                     rows_c.inc(len(buf))
                     chunks_c.inc()

@@ -16,9 +16,19 @@ import threading
 
 import numpy as np
 
-from tensorflowonspark_tpu import obs
+from tensorflowonspark_tpu import chaos, obs
 
 logger = logging.getLogger(__name__)
+
+
+class _ParseError:
+    """Per-record parse failure carried out of the thread pool (a raised
+    exception would abort the whole ``pool.map`` batch)."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error):
+        self.error = error
 
 
 def shard_files(files, num_shards, index):
@@ -55,6 +65,15 @@ class ImagePipeline:
     By default short final batches are dropped (static shapes for XLA, the
     reference's ``drop_remainder=True``); pass ``drop_remainder=False`` for
     complete-coverage eval (one extra compile for the short batch).
+
+    ``max_bad_records`` is the poisoned-input budget: records whose
+    ``parse_fn`` raises are skipped (counted in
+    ``data_records_skipped_total``) until the budget is spent, then the
+    parse error surfaces to the consumer. The default of 0 keeps the
+    strict fail-fast contract; long production runs over petabyte-scale
+    stores set a small tolerance so one torn record cannot kill an epoch.
+    Batches stay full-size — good records backfill across chunk
+    boundaries, preserving the static shapes XLA compiled for.
     """
 
     def __init__(
@@ -69,6 +88,7 @@ class ImagePipeline:
         prefetch_batches=2,
         verify_crc=False,
         drop_remainder=True,
+        max_bad_records=0,
     ):
         if not files:
             raise ValueError("no input files")
@@ -87,6 +107,7 @@ class ImagePipeline:
         #: wants every example scored — drop_remainder=False emits the short
         #: final batch (one extra compile, complete coverage)
         self.drop_remainder = drop_remainder
+        self.max_bad_records = int(max_bad_records)
 
     def _record_stream(self):
         rng = np.random.default_rng(self.seed)
@@ -101,6 +122,8 @@ class ImagePipeline:
                     idx = rng.permutation(len(records))
                     records = [records[i] for i in idx]
                 for rec in records:
+                    if chaos.active and chaos.fire("data.poison"):
+                        rec = b"\x00chaos-poisoned-record"
                     yield rec
             epoch += 1
 
@@ -130,9 +153,15 @@ class ImagePipeline:
                 except queue.Full:
                     continue
 
+        skipped_c = obs.counter(
+            "data_records_skipped_total",
+            help="undecodable records skipped within the max_bad_records budget",
+        )
+
         def producer():
-            def _emit(pool, batch):
-                parsed = list(pool.map(self.parse_fn, batch))
+            bad = []  # parse errors absorbed so far (within budget)
+
+            def _emit(parsed):
                 images = np.stack([p[0] for p in parsed])
                 # parse_fn's dtype is respected (uint8 parses quarter the
                 # host->device bytes; normalization then runs on device) —
@@ -144,18 +173,47 @@ class ImagePipeline:
                 produced_c.inc()
                 depth_g.set(out_q.qsize())
 
+            def _safe_parse(rec):
+                try:
+                    return self.parse_fn(rec)
+                except Exception as e:
+                    return _ParseError(e)
+
+            def _parse_into(pool, raw, parsed):
+                # good records backfill across raw-chunk boundaries so
+                # emitted batches stay full-size despite skips
+                for p in pool.map(_safe_parse, raw):
+                    if isinstance(p, _ParseError):
+                        if len(bad) >= self.max_bad_records:
+                            raise p.error
+                        bad.append(p.error)
+                        skipped_c.inc()
+                        logger.warning("skipping undecodable record: %s", p.error)
+                    else:
+                        parsed.append(p)
+
             try:
                 with ThreadPoolExecutor(self.num_threads) as pool:
-                    batch = []
+                    raw, parsed = [], []
                     for rec in self._record_stream():
                         if stop.is_set():
                             return
-                        batch.append(rec)
-                        if len(batch) == self.batch_size:
-                            _emit(pool, batch)
-                            batch = []
-                    if batch and not self.drop_remainder:
-                        _emit(pool, batch)
+                        raw.append(rec)
+                        if len(raw) == self.batch_size:
+                            if chaos.active:
+                                chaos.delay("data.producer_delay")
+                            _parse_into(pool, raw, parsed)
+                            raw = []
+                            while len(parsed) >= self.batch_size:
+                                _emit(parsed[: self.batch_size])
+                                parsed = parsed[self.batch_size:]
+                    if raw:
+                        _parse_into(pool, raw, parsed)
+                    while len(parsed) >= self.batch_size:
+                        _emit(parsed[: self.batch_size])
+                        parsed = parsed[self.batch_size:]
+                    if parsed and not self.drop_remainder:
+                        _emit(parsed)
                     # else: short remainder dropped (one static shape)
             except BaseException as e:  # surfaced on the consuming side
                 _final_put(e)

@@ -17,7 +17,19 @@ import os
 import subprocess
 import threading
 
+from tensorflowonspark_tpu import chaos, resilience
+
 logger = logging.getLogger(__name__)
+
+#: retry policy for shard reads: network filesystems (gcsfuse, NFS) fail
+#: transiently under pressure, and a re-read is cheap next to losing the
+#: whole ingest wave. Genuine corruption still surfaces after the budget.
+READ_RETRY = resilience.RetryPolicy(
+    max_attempts=3,
+    backoff=resilience.Backoff(base=0.1, factor=2.0, max_delay=1.0, jitter=0.5),
+    retry_on=(IOError,),
+    name="native-io-read",
+)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libtfrecord_io.so")
@@ -101,11 +113,19 @@ def available():
 def read_records(path, verify_crc=True):
     """All record payloads of one shard as a list of ``bytes``.
 
-    Raises IOError on corruption/truncation (message carried up from C).
+    Raises IOError on corruption/truncation (message carried up from C),
+    after ``READ_RETRY`` exhausts its budget (transient filesystem errors
+    heal on a re-read; corrupt bytes don't).
     """
+    return READ_RETRY.call(_read_records_once, path, verify_crc)
+
+
+def _read_records_once(path, verify_crc=True):
     lib = load_library()
     if lib is None:
         raise RuntimeError("native tfrecord_io not available")
+    if chaos.active and chaos.fire("native_io.read_fail"):
+        raise IOError("chaos: injected transient read failure for {}".format(path))
     handle = lib.tfr_load(path.encode(), 1 if verify_crc else 0)
     if not handle:
         raise IOError(lib.tfr_last_error().decode() or "tfr_load failed on {}".format(path))

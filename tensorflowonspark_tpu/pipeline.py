@@ -106,9 +106,18 @@ class Params:
         name = param.name if isinstance(param, Param) else param
         return name in self._paramMap or name in self._defaultParamMap
 
-    def extractParamMap(self):
+    def extractParamMap(self, extra=None):
+        """Defaults overlaid with explicit settings, then ``extra``.
+
+        ``extra`` accepts pyspark's dict-of-Param (or string) keys —
+        ``Pipeline.copy()`` / ML persistence call
+        ``extractParamMap(extra)``, so refusing the argument would
+        TypeError inside pyspark internals."""
         out = dict(self._defaultParamMap)
         out.update(self._paramMap)
+        if extra:
+            for k, v in extra.items():
+                out[k.name if isinstance(k, Param) else k] = v
         return out
 
     def copyParamsTo(self, other):
@@ -483,8 +492,16 @@ class TFEstimator(TFParams, HasBatchSize, HasClusterSize, HasEpochs, HasGraceSec
                 "once per configuration (each fit is a full cluster run)"
             )
         if params:
+            # pyspark fits a COPY carrying the extra params; match that
+            # observable contract by restoring the pre-call map afterwards
+            # instead of letting call-scoped params stick to the stage
+            saved = dict(self._paramMap)
             self._set(**{(k.name if isinstance(k, Param) else k): v
                          for k, v in params.items()})
+            try:
+                return self._fit(dataset)
+            finally:
+                self._paramMap = saved
         return self._fit(dataset)
 
     def _fit(self, dataset):
@@ -567,8 +584,14 @@ class TFModel(TFParams, HasBatchSize, HasInputMapping, HasOutputMapping, HasMode
 
     def transform(self, dataset, params=None):
         if params:
+            # call-scoped extra params, same restore contract as fit()
+            saved = dict(self._paramMap)
             self._set(**{(k.name if isinstance(k, Param) else k): v
                          for k, v in params.items()})
+            try:
+                return self._transform(dataset)
+            finally:
+                self._paramMap = saved
         return self._transform(dataset)
 
     def _transform(self, dataset):

@@ -31,7 +31,7 @@ import struct
 import threading
 import time
 
-from tensorflowonspark_tpu import obs
+from tensorflowonspark_tpu import chaos, obs, resilience
 
 logger = logging.getLogger(__name__)
 
@@ -277,6 +277,8 @@ class Server:
                             conn, _addr = self._sock.accept()
                         except OSError:
                             continue
+                        if chaos.active:
+                            chaos.delay("reservation.slow_accept")
                         # bounded blocking reads: a stalled client must not
                         # wedge the single-threaded control plane
                         conn.settimeout(10.0)
@@ -314,6 +316,10 @@ class Server:
         """Dispatch one control message (reference reservation.py:130-146)."""
         kind = msg.get("type") if isinstance(msg, dict) else None
         if kind == "REG":
+            if chaos.active and chaos.fire("reservation.reg_drop"):
+                # drop the connection before replying: the client sees a
+                # closed stream and re-registers (REG is idempotent)
+                raise OSError("chaos: dropped registration")
             self.reservations.add(msg.get("data", {}))
             obs.counter(
                 "reservation_registrations_total",
@@ -343,39 +349,57 @@ class Client:
     """
 
     RETRIES = 3
+    #: retry schedule shared by every request (1s, 2s, ... capped at 5s —
+    #: same envelope as the reference's fixed ``2 ** attempt`` sleep, now
+    #: jittered so a fleet of racing executors doesn't reconnect in lockstep)
+    BACKOFF = resilience.Backoff(base=1.0, factor=2.0, max_delay=5.0, jitter=0.5)
 
     def __init__(self, server_addr, timeout=30):
         self.server_addr = (server_addr[0], int(server_addr[1]))
         self.timeout = timeout
+        self._policy = resilience.RetryPolicy(
+            max_attempts=self.RETRIES,
+            backoff=self.BACKOFF,
+            retry_on=(OSError, ReservationError),
+            on_retry=self._on_retry,
+            name="reservation-client",
+        )
+
+    @staticmethod
+    def _on_retry(attempt, exc, delay):
+        obs.counter(
+            "reservation_client_retries_total",
+            help="control-plane request attempts that failed and retried",
+        ).inc()
+        logger.debug("reservation request attempt %d failed (%s); retrying in %.1fs",
+                     attempt + 1, exc, delay)
+
+    def _request_once(self, msg):
+        if chaos.active and chaos.fire("reservation.client_reset"):
+            raise ConnectionResetError("chaos: injected connection reset")
+        with socket.create_connection(self.server_addr, timeout=self.timeout) as sock:
+            msock = MessageSocket(sock)
+            msock.send(msg)
+            reply = msock.recv()
+            if reply is None:
+                raise ReservationError("server closed connection")
+            if reply.get("type") == "ERROR":
+                raise ReservationError(str(reply.get("data")))
+            return reply
 
     def _request(self, msg):
-        last_err = None
-        for attempt in range(self.RETRIES):
-            try:
-                with socket.create_connection(self.server_addr, timeout=self.timeout) as sock:
-                    msock = MessageSocket(sock)
-                    msock.send(msg)
-                    reply = msock.recv()
-                    if reply is None:
-                        raise ReservationError("server closed connection")
-                    if reply.get("type") == "ERROR":
-                        raise ReservationError(str(reply.get("data")))
-                    return reply
-            except (OSError, ReservationError) as e:
-                last_err = e
-                obs.counter(
-                    "reservation_client_retries_total",
-                    help="control-plane request attempts that failed and retried",
-                ).inc()
-                if attempt < self.RETRIES - 1:
-                    time.sleep(min(2 ** attempt, 5))
-        raise ReservationError(
-            "could not reach reservation server at {}: {}".format(self.server_addr, last_err)
-        )
+        try:
+            return self._policy.call(self._request_once, msg)
+        except (OSError, ReservationError) as e:
+            raise ReservationError(
+                "could not reach reservation server at {}: {}".format(self.server_addr, e)
+            ) from e
 
     # -- API -----------------------------------------------------------------
 
     def register(self, reservation):
+        if chaos.active:
+            chaos.delay("reservation.late_register")
         self._request({"type": "REG", "data": reservation})
 
     def get_reservations(self):

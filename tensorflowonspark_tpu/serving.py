@@ -55,7 +55,7 @@ import queue
 import socket
 import threading
 
-from tensorflowonspark_tpu import obs
+from tensorflowonspark_tpu import chaos, obs, resilience
 from tensorflowonspark_tpu.reservation import MessageSocket
 
 logger = logging.getLogger(__name__)
@@ -142,6 +142,11 @@ class _Predictor:
         # +1 slot so stop()'s sentinel can always enqueue behind a full load
         self._q = queue.Queue(maxsize=self._max_pending + 1)
         self._stop = object()
+        #: exact pending count: incremented in submit, decremented when the
+        #: request's future resolves — unlike qsize()+backlog it also covers
+        #: the batch in flight inside _run, so the Overloaded gate is a hard
+        #: bound (ADVICE r5)
+        self._pending = 0
         #: deferred non-matching requests, served FIRST next cycle — keeps
         #: FIFO so a minority-signature request can't be starved by sustained
         #: majority-signature load
@@ -196,6 +201,9 @@ class _Predictor:
         deadline = (
             _time.monotonic() + self._deadline_secs if self._deadline_secs > 0 else None
         )
+        if chaos.active and chaos.fire("serving.overload"):
+            self._shed_over_c.inc()
+            raise Overloaded("chaos: injected transient overload; request shed")
         fut = Future()
         # the lock orders every put against stop()'s sentinel: a submit that
         # wins the race enqueues BEFORE the sentinel (the run thread serves
@@ -203,23 +211,30 @@ class _Predictor:
         with self._submit_lock:
             if self._stopped:
                 raise RuntimeError("predictor stopped")
-            # count the BACKLOG too: deferred requests (signature mismatch /
-            # rows-cap overshoot) leave the queue but are still pending, and
-            # a slow model can park the entire load there — a qsize()-only
-            # gate would never fire. Both reads are exact enough under the
-            # lock (the only other mutator is the single consumer thread).
-            pending = self._q.qsize() + len(self._backlog)
-            self._pending_g.set(pending)
-            if pending >= self._max_pending:
+            # _pending counts every unresolved request — queued, parked in
+            # the backlog, AND coalesced into the batch _run is currently
+            # dispatching — so max_pending is exact: the old
+            # qsize()+backlog read went soft by one in-flight batch
+            self._pending_g.set(self._pending)
+            if self._pending >= self._max_pending:
                 self._shed_over_c.inc()
                 raise Overloaded(
                     "server overloaded: {} requests pending; request shed".format(
                         self._max_pending
                     )
                 )
+            self._pending += 1
+            # registered before the put: the consumer cannot resolve a
+            # future it has not yet been handed
+            fut.add_done_callback(self._release_pending)
             self._q.put((arrays, fut, deadline))
         with self._latency_h.time():
             return fut.result()
+
+    def _release_pending(self, _fut):
+        with self._submit_lock:
+            self._pending -= 1
+            self._pending_g.set(self._pending)
 
     def stop(self):
         with self._submit_lock:
@@ -341,9 +356,11 @@ class _Predictor:
                     break
                 _admit(nxt)
             # deferred items are older than anything left in the backlog
+            # (the pending gauge is driven by _release_pending)
             self._backlog.extendleft(reversed(deferred))
-            self._pending_g.set(self._q.qsize() + len(self._backlog))
 
+            if chaos.active:
+                chaos.delay("serving.latency")
             try:
                 if len(batch) == 1:
                     arrays = batch[0][0]
@@ -496,6 +513,8 @@ class InferenceServer:
                     return
                 if msg is None:
                     return
+                if chaos.active and chaos.fire("serving.conn_drop"):
+                    return  # close the connection mid-request
                 try:
                     if isinstance(msg, dict) and msg.get("type") == "predict_binary":
                         self._handle_binary(msock, msg)
@@ -561,22 +580,65 @@ class InferenceServer:
 
 
 class InferenceClient:
-    """Python twin of the JVM client (jvm/.../InferenceClient.java)."""
+    """Python twin of the JVM client (jvm/.../InferenceClient.java).
 
-    def __init__(self, address, timeout=120):
+    Transient failures are absorbed by a shared
+    :class:`~tensorflowonspark_tpu.resilience.RetryPolicy`: a dropped
+    connection is re-dialed and the request re-sent (prediction is
+    stateless, so replay is safe), and an ``Overloaded`` shed reply is
+    retried after backoff — the client half of the server's load-shedding
+    contract. Pass ``retry=RetryPolicy(max_attempts=1)`` for the old
+    fail-fast behavior. Non-transient error replies (bad inputs, model
+    failures) raise immediately."""
+
+    def __init__(self, address, timeout=120, retry=None):
         self.address = (address[0], int(address[1]))
         self.timeout = timeout
-        self._sock = socket.create_connection(self.address, timeout=timeout)
+        self._sock = None
+        self._msock = None
+        self._policy = retry if retry is not None else resilience.RetryPolicy(
+            max_attempts=3,
+            backoff=resilience.Backoff(base=0.2, factor=2.0, max_delay=2.0, jitter=0.5),
+            retry_on=(OSError, Overloaded),
+            name="inference-client",
+        )
+        self._connect()
+
+    def _connect(self):
+        self._sock = socket.create_connection(self.address, timeout=self.timeout)
         self._msock = MessageSocket(self._sock)
 
-    def _request(self, msg):
-        self._msock.send(msg)
-        reply = self._msock.recv()
-        if reply is None:
-            raise ConnectionError("inference server closed the connection")
+    def _reset(self):
+        if self._msock is not None:
+            self._msock.close()
+        self._sock = None
+        self._msock = None
+
+    @staticmethod
+    def _check_reply(reply):
         if reply.get("type") == "error":
-            raise RuntimeError(reply.get("message"))
+            message = str(reply.get("message") or "")
+            if message.startswith("Overloaded"):
+                raise Overloaded(message)  # transient shed: retryable
+            raise RuntimeError(message)
         return reply
+
+    def _roundtrip(self, msg):
+        if self._msock is None:
+            self._connect()
+        try:
+            self._msock.send(msg)
+            reply = self._msock.recv()
+        except OSError:
+            self._reset()
+            raise
+        if reply is None:
+            self._reset()
+            raise ConnectionError("inference server closed the connection")
+        return self._check_reply(reply)
+
+    def _request(self, msg):
+        return self._policy.call(self._roundtrip, msg)
 
     def ping(self):
         return self._request({"type": "ping"})["type"] == "pong"
@@ -598,20 +660,31 @@ class InferenceClient:
 
         arrays = {k: np.asarray(v) for k, v in inputs.items()}
         columns, payload = _arrays_to_columns(arrays)
-        self._msock.send({"type": "predict_binary", "columns": columns})
-        self._msock.send_raw(payload)
-        reply = self._msock.recv()
-        if reply is None:
-            raise ConnectionError("inference server closed the connection")
-        if reply.get("type") == "error":
-            raise RuntimeError(reply.get("message"))
-        out_payload = self._msock.recv_raw(MAX_BINARY_FRAME)
-        if out_payload is None:
-            raise ConnectionError("inference server closed mid-reply")
-        return _columns_to_arrays(reply["columns"], out_payload)
+
+        def _round():
+            if self._msock is None:
+                self._connect()
+            try:
+                self._msock.send({"type": "predict_binary", "columns": columns})
+                self._msock.send_raw(payload)
+                reply = self._msock.recv()
+                if reply is None:
+                    self._reset()
+                    raise ConnectionError("inference server closed the connection")
+                self._check_reply(reply)  # error replies carry no raw frame
+                out_payload = self._msock.recv_raw(MAX_BINARY_FRAME)
+                if out_payload is None:
+                    self._reset()
+                    raise ConnectionError("inference server closed mid-reply")
+            except OSError:
+                self._reset()
+                raise
+            return _columns_to_arrays(reply["columns"], out_payload)
+
+        return self._policy.call(_round)
 
     def close(self):
-        self._msock.close()
+        self._reset()
 
 
 # -- batch inference CLI (Inference.scala analogue) ----------------------------

@@ -12,6 +12,8 @@ shards).
 import logging
 import os
 
+from tensorflowonspark_tpu import chaos, obs
+
 logger = logging.getLogger(__name__)
 
 
@@ -70,13 +72,41 @@ def save_checkpoint(path, state, force=True):
     ckptr = _checkpointer()
     ckptr.save(path, _to_saveable(state), force=force)
     ckptr.wait_until_finished()
+    if chaos.active and chaos.fire("checkpoint.corrupt_write"):
+        _tear_checkpoint(path)
     logger.info("saved checkpoint to %s", path)
     return path
+
+
+def _tear_checkpoint(path):
+    """Chaos fault ``checkpoint.corrupt_write``: leave the checkpoint torn on
+    disk — the shape a host crash mid-write produces. Truncates the largest
+    file (the tree metadata / array data; small marker files like
+    ``_CHECKPOINT_METADATA`` are optional and orbax restores fine without
+    them). ``restore_latest`` must survive it."""
+    files = []
+    for root, _dirs, names in os.walk(path):
+        for name in names:
+            sub = os.path.join(root, name)
+            try:
+                files.append((os.path.getsize(sub), sub))
+            except OSError:
+                continue
+    for _size, sub in sorted(files, reverse=True):
+        try:
+            with open(sub, "r+b") as f:
+                f.truncate(max(0, os.path.getsize(sub) // 2))
+            logger.warning("chaos: truncated checkpoint file %s", sub)
+            return
+        except OSError:
+            continue
 
 
 def restore_checkpoint(path, target=None):
     """Restore a pytree from ``path``; ``target`` gives structure/shardings."""
     path = os.path.abspath(os.path.expanduser(path))
+    if chaos.active and chaos.fire("checkpoint.restore_fail"):
+        raise IOError("chaos: injected restore failure for {}".format(path))
     ckptr = _checkpointer()
     if target is None:
         state = ckptr.restore(path)
@@ -129,7 +159,51 @@ def latest_checkpoint(model_dir, prefix="ckpt_"):
     mistaken for the resume point nor shadow the real one. Pass
     ``prefix=""`` to accept any ``*_<digits>`` layout."""
     steps = _numbered_checkpoints(model_dir, prefix)
+    if not steps and prefix:
+        # numbered dirs that the prefix gate excluded would otherwise turn
+        # into a SILENT fresh start after a layout change — say so
+        unmatched = _numbered_checkpoints(model_dir, "")
+        if unmatched:
+            logger.warning(
+                "%s has %d step-numbered dir(s) (e.g. %s) but none match the "
+                "%r prefix; resuming from scratch. Pass prefix=\"\" to accept "
+                "any *_<digits> layout.",
+                model_dir, len(unmatched), os.path.basename(unmatched[-1][1]), prefix,
+            )
     return steps[-1][1] if steps else None
+
+
+def restore_latest(model_dir, target=None, prefix="ckpt_"):
+    """Restore the newest *restorable* checkpoint under ``model_dir``.
+
+    Walks step-numbered checkpoints newest-first and returns
+    ``(state, path)``; a checkpoint that fails to restore (torn write from a
+    crashed host, truncated array file) is skipped with a warning and a
+    ``checkpoint_restore_fallbacks_total`` count, and the next-older one is
+    tried — the resume contract survives a corrupt newest checkpoint instead
+    of dying on it. Returns ``(None, None)`` when nothing is restorable;
+    the last restore error re-raises only if every checkpoint failed AND the
+    caller had at least one to try (so "no checkpoints yet" stays a clean
+    fresh start)."""
+    steps = _numbered_checkpoints(model_dir, prefix)
+    if not steps:
+        latest_checkpoint(model_dir, prefix)  # emit the prefix-mismatch warning
+        return None, None
+    last_err = None
+    for _step, path in reversed(steps):
+        try:
+            return restore_checkpoint(path, target), path
+        except Exception as e:
+            last_err = e
+            obs.counter(
+                "checkpoint_restore_fallbacks_total",
+                help="checkpoints skipped as unrestorable during resume",
+            ).inc()
+            logger.warning(
+                "checkpoint %s is unrestorable (%s); falling back to an older one",
+                path, e,
+            )
+    raise last_err
 
 
 def prune_checkpoints(model_dir, keep):

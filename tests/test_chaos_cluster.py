@@ -1,0 +1,95 @@
+"""Integration: a seeded chaos plan exercised through a live 2-node cluster.
+
+The acceptance bar for the chaos subsystem: faults injected in every
+process — the driver's reservation server and the spawned jax children —
+are absorbed by the recovery machinery (the cluster assembles, inference
+returns correct results) and each one is visible as a counter in the merged
+``TFCluster.metrics()`` snapshot."""
+
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import TFCluster, chaos
+from tensorflowonspark_tpu.TFCluster import InputMode
+from tensorflowonspark_tpu.backends.local import LocalSparkContext
+
+pytestmark = pytest.mark.chaos
+
+CPU_ENV = {"JAX_PLATFORMS": "cpu"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+@pytest.fixture
+def sc():
+    ctx = LocalSparkContext(num_executors=2, task_timeout=120)
+    yield ctx
+    ctx.stop()
+
+
+def fn_square_feed_under_chaos(args, ctx):
+    # the plan must have propagated into the spawned jax child (env lane)
+    from tensorflowonspark_tpu import chaos as _chaos
+
+    assert _chaos.active, "chaos plan did not reach the jax child"
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        batch = feed.next_batch(16)
+        if batch:
+            feed.batch_results([x * x for x in batch])
+
+
+class TestClusterChaos:
+    def test_faults_injected_and_recovered_across_the_cluster(self, sc):
+        plan = (
+            chaos.ChaosPlan(seed=7)
+            # driver side: the reservation server drops one registration;
+            # the client's shared retry policy re-registers
+            .site("reservation.reg_drop", probability=1.0, max_count=1)
+            # child side: the DataFeed sleeps before dequeueing
+            .site("feed.slow_consumer", probability=1.0, max_count=2, delay_s=0.01)
+        )
+        chaos.install(plan)  # propagate=True: children inherit via env
+        cluster = TFCluster.run(
+            sc, fn_square_feed_under_chaos, {}, num_executors=2,
+            input_mode=InputMode.SPARK, master_node=None,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
+        )
+        try:
+            # recovery completed: every row fed and answered correctly
+            results = cluster.inference(sc.parallelize(range(100), 4)).collect()
+            assert sorted(results) == sorted(x * x for x in range(100))
+
+            # the driver-side fault fired in this process
+            assert plan.fired("reservation.reg_drop") == 1
+
+            # child counters arrive on the SnapshotPublisher interval — poll
+            # the merged snapshot until the children's faults land
+            deadline = time.monotonic() + 60
+            while True:
+                snap = cluster.metrics()
+                child_faults = (
+                    snap["counters"]
+                    .get("chaos_fault_feed_slow_consumer_total", {})
+                    .get("value", 0)
+                )
+                if child_faults >= 2 or time.monotonic() > deadline:
+                    break
+                time.sleep(0.5)
+
+            counters = snap["counters"]
+            # every fault class visible through cluster.metrics()
+            assert counters["chaos_fault_reservation_reg_drop_total"]["value"] >= 1
+            assert counters["chaos_fault_feed_slow_consumer_total"]["value"] >= 2
+            assert counters["chaos_faults_injected_total"]["value"] >= 3
+            # (the forced client retry is counted in the executor process's
+            # registry, which has no merge lane — test_chaos_reservation
+            # asserts reservation_client_retries_total in-process)
+        finally:
+            cluster.shutdown(timeout=120)

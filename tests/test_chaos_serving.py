@@ -1,0 +1,178 @@
+"""Chaos: the serving path under injected latency, dropped connections and
+transient overload. The client half of the load-shedding contract — a shared
+RetryPolicy that re-dials dropped connections (prediction is stateless, so
+replay is safe) and backs off on ``Overloaded`` — must absorb every
+transient fault class end-to-end."""
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import chaos, obs, resilience
+from tensorflowonspark_tpu.serving import (
+    InferenceClient,
+    InferenceServer,
+    Overloaded,
+    _Predictor,
+)
+from tensorflowonspark_tpu.train import export
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+@pytest.fixture
+def server(tmp_path):
+    w = np.array([[2.0], [3.0]], np.float32)
+    b = np.array([1.0], np.float32)
+
+    def predict_builder():
+        def predict(params, model_state, arrays):
+            return {"y_": arrays["x"] @ params["w"] + params["b"]}
+
+        return predict
+
+    path = str(tmp_path / "bundle")
+    export.export_model(path, predict_builder, {"w": w, "b": b})
+    srv = InferenceServer(path)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _fast_client(server, attempts=3):
+    return InferenceClient(
+        server.address,
+        timeout=30,
+        retry=resilience.RetryPolicy(
+            max_attempts=attempts,
+            backoff=resilience.Backoff(base=0.02, factor=2.0, max_delay=0.1,
+                                       jitter=0.5, seed=0),
+            retry_on=(OSError, Overloaded),
+            name="inference-client",
+        ),
+    )
+
+
+def _counter(name):
+    return obs.snapshot()["counters"].get(name, {}).get("value", 0)
+
+
+class TestServingChaos:
+    def test_injected_latency_only_slows_the_answer(self, server):
+        plan = chaos.ChaosPlan(seed=0).site(
+            "serving.latency", probability=1.0, max_count=2, delay_s=0.05
+        )
+        chaos.install(plan, propagate=False)
+        client = _fast_client(server)
+        try:
+            out = client.predict(x=[[1.0, 2.0]])
+            np.testing.assert_allclose(out["y_"], [[9.0]])
+        finally:
+            client.close()
+        assert plan.fired("serving.latency") >= 1
+
+    def test_client_redials_through_dropped_connections(self, server):
+        plan = chaos.ChaosPlan(seed=1).site(
+            "serving.conn_drop", probability=1.0, max_count=2
+        )
+        chaos.install(plan, propagate=False)
+        client = _fast_client(server)
+        try:
+            # each drop closes the connection mid-request; the retry policy
+            # re-dials and replays
+            out = client.predict(x=[[1.0, 2.0]])
+            np.testing.assert_allclose(out["y_"], [[9.0]])
+            out = client.predict(x=[[0.0, 0.0]])
+            np.testing.assert_allclose(out["y_"], [[1.0]])
+        finally:
+            client.close()
+        assert plan.fired("serving.conn_drop") == 2
+        assert _counter("chaos_fault_serving_conn_drop_total") >= 2
+
+    def test_binary_lane_redials_through_dropped_connection(self, server):
+        plan = chaos.ChaosPlan(seed=2).site(
+            "serving.conn_drop", probability=1.0, max_count=1
+        )
+        chaos.install(plan, propagate=False)
+        client = _fast_client(server)
+        try:
+            out = client.predict_binary(x=np.array([[1.0, 2.0]], np.float32))
+            np.testing.assert_allclose(out["y_"], [[9.0]])
+        finally:
+            client.close()
+        assert plan.fired("serving.conn_drop") == 1
+
+    def test_client_backs_off_through_transient_overload(self, server):
+        plan = chaos.ChaosPlan(seed=3).site(
+            "serving.overload", probability=1.0, max_count=2
+        )
+        chaos.install(plan, propagate=False)
+        shed_before = _counter("serving_shed_overloaded_total")
+        client = _fast_client(server)
+        try:
+            # attempts 1 and 2 come back as Overloaded error replies; the
+            # third lands
+            out = client.predict(x=[[1.0, 2.0]])
+            np.testing.assert_allclose(out["y_"], [[9.0]])
+        finally:
+            client.close()
+        assert plan.fired("serving.overload") == 2
+        assert _counter("serving_shed_overloaded_total") - shed_before == 2
+
+    def test_fail_fast_client_surfaces_overload(self, server):
+        chaos.install(
+            chaos.ChaosPlan(seed=3).site("serving.overload", probability=1.0),
+            propagate=False,
+        )
+        client = _fast_client(server, attempts=1)
+        try:
+            with pytest.raises(Overloaded):
+                client.predict(x=[[1.0, 2.0]])
+        finally:
+            client.close()
+
+
+class TestExactPendingBound:
+    def test_pending_counter_returns_to_zero(self):
+        pred = _Predictor(lambda p, ms, a: {"y": a["x"]}, None, None, max_pending=4)
+        try:
+            for _ in range(3):
+                pred.submit({"x": np.ones((2, 2), np.float32)})
+            assert pred._pending == 0  # every future resolved -> fully released
+        finally:
+            pred.stop()
+
+    def test_single_slot_rejects_concurrent_second_request(self):
+        import threading
+        import time
+
+        release = threading.Event()
+
+        def slow_fn(params, model_state, arrays):
+            release.wait(30)
+            return {"y": arrays["x"]}
+
+        # max_pending=1 is exact: the in-flight request fills the only slot
+        pred = _Predictor(slow_fn, None, None, max_pending=1)
+        try:
+            results = []
+            t = threading.Thread(
+                target=lambda: results.append(pred.submit({"x": np.ones((1, 2), np.float32)}))
+            )
+            t.start()
+            time.sleep(0.4)
+            with pytest.raises(Overloaded):
+                pred.submit({"x": np.ones((1, 2), np.float32)})
+            release.set()
+            t.join(timeout=30)
+            assert len(results) == 1
+            assert pred._pending == 0
+        finally:
+            release.set()
+            pred.stop()

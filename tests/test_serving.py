@@ -587,7 +587,9 @@ def test_overload_sheds_with_error():
         release.wait(30)
         return {"y": arrays["x"].sum(axis=1, keepdims=True)}
 
-    pred = _Predictor(slow_fn, None, None, max_pending=2)
+    # the pending bound is exact — it counts the in-flight request too, so
+    # capacity 3 = 1 blocked in dispatch + 2 queued
+    pred = _Predictor(slow_fn, None, None, max_pending=3)
     # obs counters are process-global and cumulative across tests: take deltas
     requests_before = pred._requests_c.value
     shed_before = pred._shed_over_c.value
