@@ -49,13 +49,38 @@ import time
 REFERENCE_IMG_PER_SEC_PER_CHIP = 2000.0
 
 
-def confidence_fields(pairs_recorded, pairs_requested):
+#: a train block cannot beat its own input path: both consume the same
+#: prefetch generator, so ratios above ~1.0 mean the link/host mood shifted
+#: between the two blocks of a pair. Beyond this tolerance the pair is
+#: measurement noise, not signal — it is flagged and excluded from the
+#: median (BENCH_r05 folded a physically impossible 3.30 into its headline).
+MAX_VALID_PAIR_RATIO = 1.10
+
+
+def partition_pairs(nc_rates, tr_rates, max_ratio=MAX_VALID_PAIR_RATIO):
+    """Split recorded (no-compute, train) rate pairs into valid and invalid
+    by their train/input-path ratio. Returns ``(valid, invalid)`` as lists
+    of ``(nc, tr)`` tuples, preserving pair order."""
+    valid, invalid = [], []
+    for nc, tr in zip(nc_rates, tr_rates):
+        (valid if tr / nc <= max_ratio else invalid).append((nc, tr))
+    return valid, invalid
+
+
+def confidence_fields(pairs_recorded, pairs_requested, invalid_pairs=0):
     """Annotation for pair-budgeted results: how many train/no-compute pairs
-    actually landed, and ``low_confidence: true`` when the time budget cut the
-    run short of the requested count (the median then rests on fewer samples
-    than the operator asked for)."""
-    fields = {"pairs": int(pairs_recorded)}
-    if pairs_recorded < pairs_requested:
+    actually landed out of how many were requested, how many were discarded
+    as invalid (ratio > :data:`MAX_VALID_PAIR_RATIO`), and
+    ``low_confidence: true`` when the median rests on fewer usable samples
+    than the operator asked for (budget cut the run short, or pairs were
+    discarded)."""
+    fields = {
+        "pairs": int(pairs_recorded),
+        "pairs_requested": int(pairs_requested),
+    }
+    if invalid_pairs:
+        fields["invalid_pairs"] = int(invalid_pairs)
+    if pairs_recorded - invalid_pairs < pairs_requested:
         fields["low_confidence"] = True
     return fields
 
@@ -349,20 +374,35 @@ def bench_resnet(tiny, real_data):
                 tr_rates.append(tr)
                 ratios.append(tr / nc)
                 rate_est = nc
-            value = statistics.median(tr_rates) / n_chips
-            ratio_spread = (min(ratios), max(ratios))
-            link_ceiling = statistics.median(nc_rates) / n_chips
-            conf = confidence_fields(len(ratios), reps)
+            valid, invalid = partition_pairs(nc_rates, tr_rates)
             print(
                 "resnet_real pairs: train {} img/s | input-path-only {} img/s | "
-                "per-pair ratios {} ({})".format(
+                "per-pair ratios {} ({}){}".format(
                     [round(v / n_chips, 1) for v in tr_rates],
                     [round(v / n_chips, 1) for v in nc_rates],
                     [round(r, 3) for r in ratios],
                     "packed" if packed else "per-batch",
+                    " | {} invalid pair(s) discarded (ratio > {})".format(
+                        len(invalid), MAX_VALID_PAIR_RATIO
+                    ) if invalid else "",
                 ),
                 file=sys.stderr,
             )
+            if not valid:
+                # every pair tripped the validity bound — report the raw set
+                # rather than divide by zero, flagged low-confidence below
+                print(
+                    "all {} pairs invalid; falling back to the raw set".format(
+                        len(invalid)
+                    ),
+                    file=sys.stderr,
+                )
+                valid = list(zip(nc_rates, tr_rates))
+            ratios = [tr / nc for nc, tr in valid]
+            value = statistics.median([tr for _nc, tr in valid]) / n_chips
+            ratio_spread = (min(ratios), max(ratios))
+            link_ceiling = statistics.median([nc for nc, _tr in valid]) / n_chips
+            conf = confidence_fields(len(nc_rates), reps, invalid_pairs=len(invalid))
         else:
             conf = {}
             t0 = time.perf_counter()

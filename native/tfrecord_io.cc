@@ -225,6 +225,171 @@ const uint64_t* tfr_offsets(const TfrFile* f) { return f->offsets; }
 const uint64_t* tfr_lengths(const TfrFile* f) { return f->lengths; }
 
 // ---------------------------------------------------------------------------
+// Streaming reader: open once, pull bounded chunks. The chunked twin of
+// tfr_load for the pipelined input path — a shard no longer has to be fully
+// materialized before the first record flows, and the Python side bounds
+// peak memory at (chunk records) instead of (shard records). Each chunk is
+// returned as a TfrFile (same contiguous buffer + span index contract as
+// tfr_load; freed with tfr_free), so the binding slices records identically
+// in both modes.
+// ---------------------------------------------------------------------------
+
+struct TfrStream {
+  FILE* fp;
+  int verify_crc;
+  uint64_t record_index;  // records consumed so far (error messages)
+  char* path;             // owned copy for error messages
+};
+
+TfrStream* tfr_stream_open(const char* path, int verify_crc) {
+  g_err[0] = 0;
+  FILE* fp = fopen(path, "rb");
+  if (!fp) {
+    set_err("cannot open %s (record %llu)", path, 0);
+    return nullptr;
+  }
+  TfrStream* s = (TfrStream*)malloc(sizeof(TfrStream));
+  char* path_copy = (char*)malloc(strlen(path) + 1);
+  if (!s || !path_copy) {
+    set_err("out of memory opening stream on %s (record %llu)", path, 0);
+    free(s);
+    free(path_copy);
+    fclose(fp);
+    return nullptr;
+  }
+  strcpy(path_copy, path);
+  s->fp = fp;
+  s->verify_crc = verify_crc;
+  s->record_index = 0;
+  s->path = path_copy;
+  return s;
+}
+
+void tfr_stream_close(TfrStream* s) {
+  if (!s) return;
+  if (s->fp) fclose(s->fp);
+  free(s->path);
+  free(s);
+}
+
+// Read up to max_records sequentially from the stream position. Returns a
+// TfrFile chunk, or NULL at clean EOF (tfr_last_error empty) or on error
+// (tfr_last_error set). A short chunk is only returned at end of file.
+TfrFile* tfr_stream_next(TfrStream* s, uint64_t max_records) {
+  g_err[0] = 0;
+  if (!s || !s->fp || max_records == 0) return nullptr;
+  uint64_t buf_cap = 1 << 20, buf_len = 0;
+  uint64_t idx_cap = max_records < 1024 ? max_records : 1024;
+  uint64_t count = 0;
+  uint8_t* buf = (uint8_t*)malloc(buf_cap);
+  uint64_t* offsets = (uint64_t*)malloc(idx_cap * sizeof(uint64_t));
+  uint64_t* lengths = (uint64_t*)malloc(idx_cap * sizeof(uint64_t));
+  if (!buf || !offsets || !lengths) {
+    set_err("out of memory for chunk on %s (record %llu)", s->path,
+            s->record_index);
+    goto fail;
+  }
+  while (count < max_records) {
+    uint8_t header[12];
+    size_t got = fread(header, 1, 12, s->fp);
+    if (got == 0) break;  // clean EOF at a record boundary
+    if (got != 12) {
+      set_err("truncated length header in %s (record %llu)", s->path,
+              s->record_index);
+      goto fail;
+    }
+    {
+      uint64_t len = read_u64(header);
+      uint32_t len_crc = read_u32(header + 8);
+      if (s->verify_crc && masked_crc(header, 8) != len_crc) {
+        set_err("corrupt length crc in %s (record %llu)", s->path,
+                s->record_index);
+        goto fail;
+      }
+      // reject a corrupt huge len before trying to allocate it: the payload
+      // plus its crc cannot exceed what is left of the file
+      long cur = ftell(s->fp);
+      fseek(s->fp, 0, SEEK_END);
+      long end = ftell(s->fp);
+      fseek(s->fp, cur, SEEK_SET);
+      if (end < cur || len > (uint64_t)(end - cur) ||
+          (uint64_t)(end - cur) - len < 4) {
+        set_err("truncated payload in %s (record %llu)", s->path,
+                s->record_index);
+        goto fail;
+      }
+      while (buf_len + len > buf_cap) {
+        buf_cap *= 2;
+        uint8_t* new_buf = (uint8_t*)realloc(buf, buf_cap);
+        if (!new_buf) {
+          set_err("out of memory growing chunk on %s (record %llu)", s->path,
+                  s->record_index);
+          goto fail;
+        }
+        buf = new_buf;
+      }
+      uint8_t crc_bytes[4];
+      if (fread(buf + buf_len, 1, len, s->fp) != len ||
+          fread(crc_bytes, 1, 4, s->fp) != 4) {
+        set_err("truncated payload in %s (record %llu)", s->path,
+                s->record_index);
+        goto fail;
+      }
+      if (s->verify_crc &&
+          masked_crc(buf + buf_len, len) != read_u32(crc_bytes)) {
+        set_err("corrupt payload crc in %s (record %llu)", s->path,
+                s->record_index);
+        goto fail;
+      }
+      if (count == idx_cap) {
+        idx_cap *= 2;
+        uint64_t* new_offsets =
+            (uint64_t*)realloc(offsets, idx_cap * sizeof(uint64_t));
+        uint64_t* new_lengths =
+            (uint64_t*)realloc(lengths, idx_cap * sizeof(uint64_t));
+        if (new_offsets) offsets = new_offsets;
+        if (new_lengths) lengths = new_lengths;
+        if (!new_offsets || !new_lengths) {
+          set_err("out of memory growing chunk index on %s (record %llu)",
+                  s->path, s->record_index);
+          goto fail;
+        }
+      }
+      offsets[count] = buf_len;
+      lengths[count] = len;
+      buf_len += len;
+      count++;
+      s->record_index++;
+    }
+  }
+  if (count == 0) {  // clean EOF with nothing read
+    free(buf);
+    free(offsets);
+    free(lengths);
+    return nullptr;
+  }
+  {
+    TfrFile* f = (TfrFile*)malloc(sizeof(TfrFile));
+    if (!f) {
+      set_err("out of memory for chunk handle on %s (record %llu)", s->path,
+              s->record_index);
+      goto fail;
+    }
+    f->buf = buf;
+    f->buf_len = buf_len;
+    f->offsets = offsets;
+    f->lengths = lengths;
+    f->count = count;
+    return f;
+  }
+fail:
+  free(buf);
+  free(offsets);
+  free(lengths);
+  return nullptr;
+}
+
+// ---------------------------------------------------------------------------
 // Writer: frame `count` records (concatenated in `payloads`, spans given by
 // offsets/lengths) into `path` in one call.
 // ---------------------------------------------------------------------------

@@ -7,14 +7,18 @@
 # With pyspark installed: additionally boots a local-cluster master so the
 # integration tests can target real Spark executors.
 #
-# Usage: ./run_tests.sh [--quick] [--chaos] [extra pytest args]
-#   --quick  run the quick tier only (pytest -m 'not slow')
-#   --chaos  run the quick tier under a fixed low-probability ChaosPlan and
-#            assert that at least one fault was actually injected
+# Usage: ./run_tests.sh [--quick] [--chaos] [--perf-smoke] [extra pytest args]
+#   --quick       run the quick tier only (pytest -m 'not slow')
+#   --chaos       run the quick tier under a fixed low-probability ChaosPlan and
+#                 assert that at least one fault was actually injected
+#   --perf-smoke  run only the perf_smoke marker leg: structural pipelining
+#                 assertions (sleep-staged IO/parse overlap — proves the
+#                 read-ahead actually overlaps, no absolute-throughput flake)
 set -euo pipefail
 cd "$(dirname "$0")"
 
 CHAOS=0
+PERF_SMOKE=0
 EXTRA=()
 for arg in "$@"; do
   if [[ "$arg" == "--quick" ]]; then
@@ -22,6 +26,8 @@ for arg in "$@"; do
   elif [[ "$arg" == "--chaos" ]]; then
     CHAOS=1
     EXTRA+=(-m "not slow")
+  elif [[ "$arg" == "--perf-smoke" ]]; then
+    PERF_SMOKE=1
   else
     EXTRA+=("$arg")
   fi
@@ -43,6 +49,10 @@ else
   echo "pyspark not installed: using the bundled local multi-process backend"
 fi
 
+if [[ "$PERF_SMOKE" == "1" ]]; then
+  exec python -m pytest tests/ -q -m perf_smoke ${EXTRA[@]+"${EXTRA[@]}"}
+fi
+
 if [[ "$CHAOS" == "1" ]]; then
   # Benign (delay-only) sites at low probability: the suite's assertions
   # must keep passing — chaos here perturbs timing, not outcomes. Error
@@ -51,6 +61,7 @@ if [[ "$CHAOS" == "1" ]]; then
     "feed.stall":           {"probability": 0.02, "max_count": null, "delay_s": 0.01},
     "feed.slow_consumer":   {"probability": 0.02, "max_count": null, "delay_s": 0.01},
     "data.producer_delay":  {"probability": 0.05, "max_count": null, "delay_s": 0.01},
+    "data.shard_read":      {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "serving.latency":      {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "reservation.slow_accept": {"probability": 0.05, "max_count": null, "delay_s": 0.01}
   }}'

@@ -48,6 +48,9 @@ site                            effect at the injection point
 ``feed.truncate_chunk``         train feeder drops the tail of one chunk
 ``data.producer_delay``         loader producer sleeps before emitting
 ``data.poison``                 loader yields one undecodable record
+``data.shard_read``             read-ahead shard open sleeps (``delay_s``) or
+                                raises ``IOError`` (``error: true``); errors
+                                are retried under ``SHARD_READ_RETRY``
 ``checkpoint.corrupt_write``    newest checkpoint left torn on disk
 ``checkpoint.restore_fail``     restore raises ``IOError``
 ``serving.latency``             predictor sleeps before dispatch

@@ -4,9 +4,11 @@ path).
 The reference shipped its input pipeline as example code driving tf.data
 (/root/reference/examples/resnet/imagenet_preprocessing.py:259 input_fn,
 cifar_preprocessing.py:42 parse_record); here it is a framework subpackage:
-TFRecord shards are bulk-read through the native C++ reader
-(:mod:`tensorflowonspark_tpu.native_io`), images decoded/augmented with
-PIL+numpy on a thread pool, and fixed-shape batches double-buffered onto the
+TFRecord shards are streamed in chunks through the native C++ reader
+(:mod:`tensorflowonspark_tpu.native_io`) with shard read-ahead overlapping
+IO against the parse stage, records re-ordered by a bounded shuffle buffer,
+images decoded/augmented with PIL+numpy on a thread pool straight into
+preallocated batch buffers, and fixed-shape batches double-buffered onto the
 device mesh — static shapes and steady feed keep XLA and the MXU busy.
 """
 

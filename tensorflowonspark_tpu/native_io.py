@@ -66,6 +66,24 @@ def _bind(lib):
     ]
     lib.tfr_masked_crc32c.restype = ctypes.c_uint32
     lib.tfr_masked_crc32c.argtypes = [ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64]
+    # streaming entry points (the chunked input path); a stale prebuilt
+    # library without them still serves the bulk API — callers check
+    # stream_available() and fall back to the Python codec
+    try:
+        lib.tfr_stream_open.restype = ctypes.c_void_p
+        lib.tfr_stream_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.tfr_stream_close.restype = None
+        lib.tfr_stream_close.argtypes = [ctypes.c_void_p]
+        lib.tfr_stream_next.restype = ctypes.c_void_p
+        lib.tfr_stream_next.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.tfr_has_stream = True
+    except AttributeError:
+        logger.warning(
+            "native tfrecord_io library predates the streaming API; "
+            "chunked reads fall back to the Python codec (rebuild with "
+            "`make -B` in native/)"
+        )
+        lib.tfr_has_stream = False
     return lib
 
 
@@ -120,6 +138,17 @@ def read_records(path, verify_crc=True):
     return READ_RETRY.call(_read_records_once, path, verify_crc)
 
 
+def _slice_records(lib, handle):
+    """Record payloads out of one loaded handle (bulk file or stream chunk):
+    one copy per record straight out of the C buffer (a whole-buffer bytes
+    intermediate would double peak memory on the ingest path)."""
+    count = lib.tfr_count(handle)
+    base = ctypes.cast(lib.tfr_buffer(handle), ctypes.c_void_p).value
+    offsets = lib.tfr_offsets(handle)
+    lengths = lib.tfr_lengths(handle)
+    return [ctypes.string_at(base + offsets[i], lengths[i]) for i in range(count)]
+
+
 def _read_records_once(path, verify_crc=True):
     lib = load_library()
     if lib is None:
@@ -130,17 +159,57 @@ def _read_records_once(path, verify_crc=True):
     if not handle:
         raise IOError(lib.tfr_last_error().decode() or "tfr_load failed on {}".format(path))
     try:
-        count = lib.tfr_count(handle)
-        base = ctypes.cast(lib.tfr_buffer(handle), ctypes.c_void_p).value
-        offsets = lib.tfr_offsets(handle)
-        lengths = lib.tfr_lengths(handle)
-        # one copy per record straight out of the C buffer (a whole-file
-        # bytes intermediate would double peak memory on the ingest path)
-        return [
-            ctypes.string_at(base + offsets[i], lengths[i]) for i in range(count)
-        ]
+        return _slice_records(lib, handle)
     finally:
         lib.tfr_free(handle)
+
+
+def stream_available():
+    """True when the loaded library exposes the chunked streaming API (a
+    stale prebuilt ``libtfrecord_io.so`` may predate it)."""
+    lib = load_library()
+    return lib is not None and lib.tfr_has_stream
+
+
+def _stream_open(lib, path, verify_crc):
+    if chaos.active and chaos.fire("native_io.read_fail"):
+        raise IOError("chaos: injected transient read failure for {}".format(path))
+    handle = lib.tfr_stream_open(path.encode(), 1 if verify_crc else 0)
+    if not handle:
+        raise IOError(
+            lib.tfr_last_error().decode() or "tfr_stream_open failed on {}".format(path)
+        )
+    return handle
+
+
+def read_records_chunked(path, chunk_records=1024, verify_crc=True):
+    """Yield lists of up to ``chunk_records`` record payloads, reading the
+    shard incrementally (``tfr_stream_next``) instead of materializing it.
+
+    The streaming half of the pipelined input path: peak memory is one chunk
+    (plus the OS page cache), and the first record flows after one chunk's
+    worth of IO instead of a whole shard's. The open is retried under
+    ``READ_RETRY`` (transient filesystem errors); mid-stream corruption is
+    NOT retried — the stream position is gone, and corrupt bytes don't heal.
+    """
+    lib = load_library()
+    if lib is None or not lib.tfr_has_stream:
+        raise RuntimeError("native tfrecord_io streaming not available")
+    handle = READ_RETRY.call(_stream_open, lib, path, verify_crc)
+    try:
+        while True:
+            chunk = lib.tfr_stream_next(handle, int(chunk_records))
+            if not chunk:
+                err = lib.tfr_last_error().decode()
+                if err:
+                    raise IOError(err)
+                return  # clean EOF
+            try:
+                yield _slice_records(lib, chunk)
+            finally:
+                lib.tfr_free(chunk)
+    finally:
+        lib.tfr_stream_close(handle)
 
 
 def write_records(path, records):

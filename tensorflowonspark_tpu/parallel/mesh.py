@@ -57,6 +57,31 @@ def _normalize_axes(axes, num_devices):
     return {a: axes[a] for a in ordered}
 
 
+def _warn_if_multi_slice(devices):
+    """Warn when a flat reshape would span distinct TPU slices.
+
+    Multi-slice worlds (TPU v4+ megascale / multi-pod DCN) expose a
+    ``slice_index`` on each device; a plain reshape interleaves slices, so
+    mesh-neighbour collectives cross the slow DCN boundary instead of riding
+    ICI. Returns the set of distinct slice indices (empty when the attribute
+    is absent) so tests can probe the detection with fake device objects.
+    """
+    slices = {
+        getattr(d, "slice_index") for d in devices if getattr(d, "slice_index", None) is not None
+    }
+    if len(slices) > 1:
+        logger.warning(
+            "devices span %d distinct slices (slice_index %s) but the mesh is "
+            "a flat reshape — inner-axis collectives will cross the DCN "
+            "boundary. Build the mesh with "
+            "jax.experimental.mesh_utils.create_hybrid_device_mesh (ICI axes "
+            "inner, DCN axes outer) instead.",
+            len(slices),
+            sorted(slices),
+        )
+    return slices
+
+
 def build_mesh(axes=None, devices=None, drop_trivial=False):
     """Build a :class:`jax.sharding.Mesh` with named axes over the devices.
 
@@ -78,6 +103,9 @@ def build_mesh(axes=None, devices=None, drop_trivial=False):
         shape = {a: s for a, s in shape.items() if s > 1} or {"dp": 1}
 
     dims = tuple(shape.values())
+    # multi-slice worlds need a hybrid (ICI-inner / DCN-outer) layout that
+    # neither create_device_mesh nor a flat reshape provides — surface it
+    _warn_if_multi_slice(devices)
     platform = devices[0].platform if devices else "cpu"
     if platform == "tpu":
         try:

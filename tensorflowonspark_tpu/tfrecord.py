@@ -135,6 +135,22 @@ def read_records(path, verify_crc=True):
             yield data
 
 
+def read_records_chunked(path, chunk_records=1024, verify_crc=True):
+    """Yield lists of up to ``chunk_records`` raw records — the streaming
+    twin of :func:`read_records`, shaped like
+    :func:`tensorflowonspark_tpu.native_io.read_records_chunked` so the
+    loader's chunked path works identically with either codec (this one also
+    covers fsspec URIs, which the native reader cannot open)."""
+    chunk = []
+    for rec in read_records(path, verify_crc=verify_crc):
+        chunk.append(rec)
+        if len(chunk) >= chunk_records:
+            yield chunk
+            chunk = []
+    if chunk:
+        yield chunk
+
+
 # -- minimal protobuf wire codec ----------------------------------------------
 
 

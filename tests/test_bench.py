@@ -11,16 +11,45 @@ _spec.loader.exec_module(bench)
 
 
 def test_confidence_fields_full_budget():
-    # all requested pairs recorded: no low-confidence flag in the JSON
-    assert bench.confidence_fields(6, 6) == {"pairs": 6}
-    assert bench.confidence_fields(7, 6) == {"pairs": 7}
+    # all requested pairs recorded and valid: no low-confidence flag
+    assert bench.confidence_fields(6, 6) == {"pairs": 6, "pairs_requested": 6}
+    assert bench.confidence_fields(7, 6) == {"pairs": 7, "pairs_requested": 6}
 
 
 def test_confidence_fields_budget_exhausted():
     out = bench.confidence_fields(3, 6)
-    assert out == {"pairs": 3, "low_confidence": True}
+    assert out == {"pairs": 3, "pairs_requested": 6, "low_confidence": True}
 
 
 def test_confidence_fields_zero_pairs():
     out = bench.confidence_fields(0, 6)
     assert out["pairs"] == 0 and out["low_confidence"] is True
+
+
+def test_confidence_fields_invalid_pairs_lower_confidence():
+    # 6 pairs ran but one was discarded: the median rests on 5 samples
+    out = bench.confidence_fields(6, 6, invalid_pairs=1)
+    assert out["pairs"] == 6
+    assert out["invalid_pairs"] == 1
+    assert out["low_confidence"] is True
+
+
+def test_partition_pairs_flags_impossible_ratios():
+    # train cannot beat its own input path: the 3.30 pair is noise
+    nc = [100.0, 100.0, 100.0]
+    tr = [95.0, 330.0, 102.0]
+    valid, invalid = bench.partition_pairs(nc, tr)
+    assert valid == [(100.0, 95.0), (100.0, 102.0)]
+    assert invalid == [(100.0, 330.0)]
+
+
+def test_partition_pairs_boundary_is_inclusive():
+    valid, invalid = bench.partition_pairs([100.0], [110.0])
+    assert valid and not invalid  # ratio == 1.10 exactly: still valid
+    valid, invalid = bench.partition_pairs([100.0], [111.0])
+    assert invalid and not valid
+
+
+def test_partition_pairs_all_valid():
+    valid, invalid = bench.partition_pairs([100.0, 90.0], [99.0, 91.0])
+    assert len(valid) == 2 and not invalid

@@ -45,6 +45,33 @@ def fn_square_feed_under_chaos(args, ctx):
             feed.batch_results([x * x for x in batch])
 
 
+def fn_pipeline_under_chaos(args, ctx):
+    # the read-ahead reader must hit the data.shard_read site inside the
+    # spawned child, and the fault counter must travel back through the
+    # metrics merge lane
+    import numpy as np
+
+    from tensorflowonspark_tpu import chaos as _chaos
+    from tensorflowonspark_tpu.data import ImagePipeline
+
+    assert _chaos.active, "chaos plan did not reach the jax child"
+
+    def parse(rec):
+        v = int(rec)
+        return np.full((2, 2, 1), v, np.float32), v
+
+    pipe = ImagePipeline(
+        [args["shard"]], parse, batch_size=4, shuffle=False, epochs=1,
+        readahead=2, chunk_records=8,
+    )
+    n = sum(b["label"].shape[0] for b in pipe)
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        batch = feed.next_batch(16)
+        if batch:
+            feed.batch_results([n for _ in batch])
+
+
 class TestClusterChaos:
     def test_faults_injected_and_recovered_across_the_cluster(self, sc):
         plan = (
@@ -91,5 +118,46 @@ class TestClusterChaos:
             # (the forced client retry is counted in the executor process's
             # registry, which has no merge lane — test_chaos_reservation
             # asserts reservation_client_retries_total in-process)
+        finally:
+            cluster.shutdown(timeout=120)
+
+    def test_shard_read_faults_surface_in_cluster_metrics(self, sc, tmp_path):
+        from tensorflowonspark_tpu import tfrecord
+
+        shard = str(tmp_path / "part-00000")
+        with tfrecord.TFRecordWriter(shard) as w:
+            for i in range(16):
+                w.write(str(i).encode())
+
+        # delay faults on every shard open: absorbed invisibly by the
+        # read-ahead reader, visible only as counters
+        plan = chaos.ChaosPlan(seed=3).site(
+            "data.shard_read", probability=1.0, max_count=2, delay_s=0.01
+        )
+        chaos.install(plan)  # propagate=True: children inherit via env
+        cluster = TFCluster.run(
+            sc, fn_pipeline_under_chaos, {"shard": shard}, num_executors=2,
+            input_mode=InputMode.SPARK, master_node=None,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
+        )
+        try:
+            # every child consumed all 16 records through the chaos-delayed
+            # read-ahead path
+            results = cluster.inference(sc.parallelize(range(8), 4)).collect()
+            assert results == [16] * 8
+
+            # child counters arrive on the SnapshotPublisher interval
+            deadline = time.monotonic() + 60
+            while True:
+                snap = cluster.metrics()
+                faults = (
+                    snap["counters"]
+                    .get("chaos_fault_data_shard_read_total", {})
+                    .get("value", 0)
+                )
+                if faults >= 1 or time.monotonic() > deadline:
+                    break
+                time.sleep(0.5)
+            assert faults >= 1
         finally:
             cluster.shutdown(timeout=120)

@@ -251,3 +251,35 @@ def test_prune_checkpoints_keeps_newest(tmp_path):
     assert checkpoint.latest_checkpoint(str(tmp_path)).endswith("ckpt_10")
     assert checkpoint.latest_checkpoint(str(tmp_path), prefix="").endswith("run_99")
     assert checkpoint.prune_checkpoints(str(tmp_path), keep=0) == 0  # disabled
+
+
+class _FakeDevice:
+    def __init__(self, slice_index=None):
+        if slice_index is not None:
+            self.slice_index = slice_index
+
+
+class TestMultiSliceWarning:
+    def test_distinct_slice_indices_warn(self, caplog):
+        from tensorflowonspark_tpu.parallel import mesh
+
+        devs = [_FakeDevice(0), _FakeDevice(0), _FakeDevice(1), _FakeDevice(1)]
+        with caplog.at_level("WARNING", logger="tensorflowonspark_tpu.parallel.mesh"):
+            slices = mesh._warn_if_multi_slice(devs)
+        assert slices == {0, 1}
+        assert any("create_hybrid_device_mesh" in r.message for r in caplog.records)
+
+    def test_single_slice_is_silent(self, caplog):
+        from tensorflowonspark_tpu.parallel import mesh
+
+        with caplog.at_level("WARNING", logger="tensorflowonspark_tpu.parallel.mesh"):
+            assert mesh._warn_if_multi_slice([_FakeDevice(0), _FakeDevice(0)]) == {0}
+        assert not caplog.records
+
+    def test_devices_without_slice_index_are_silent(self, caplog):
+        # CPU/virtual devices have no slice_index at all
+        from tensorflowonspark_tpu.parallel import mesh
+
+        with caplog.at_level("WARNING", logger="tensorflowonspark_tpu.parallel.mesh"):
+            assert mesh._warn_if_multi_slice([_FakeDevice(), _FakeDevice()]) == set()
+        assert not caplog.records
