@@ -65,6 +65,8 @@ MODULES = [
     "tensorflowonspark_tpu.ops.fused_bn",
     "tensorflowonspark_tpu.backends",
     "tensorflowonspark_tpu.backends.local",
+    "tosa",
+    "tosa.core",
 ]
 
 

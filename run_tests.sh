@@ -7,18 +7,24 @@
 # With pyspark installed: additionally boots a local-cluster master so the
 # integration tests can target real Spark executors.
 #
-# Usage: ./run_tests.sh [--quick] [--chaos] [--perf-smoke] [extra pytest args]
+# Usage: ./run_tests.sh [--quick] [--chaos] [--perf-smoke] [--analyze]
+#                       [--native-sanitize] [extra pytest args]
 #   --quick       run the quick tier only (pytest -m 'not slow')
 #   --chaos       run the quick tier under a fixed low-probability ChaosPlan and
 #                 assert that at least one fault was actually injected
 #   --perf-smoke  run only the perf_smoke marker leg: structural pipelining
 #                 assertions (sleep-staged IO/parse overlap — proves the
 #                 read-ahead actually overlaps, no absolute-throughput flake)
+#   --analyze     print the full tosa static-analysis report as JSON and exit
+#   --native-sanitize  rebuild native/tfrecord_io.cc with ASan+UBSan and run
+#                 the native IO / streaming-chunk tests against it (skips
+#                 cleanly when no g++ toolchain is present)
 set -euo pipefail
 cd "$(dirname "$0")"
 
 CHAOS=0
 PERF_SMOKE=0
+NATIVE_SANITIZE=0
 EXTRA=()
 for arg in "$@"; do
   if [[ "$arg" == "--quick" ]]; then
@@ -28,17 +34,45 @@ for arg in "$@"; do
     EXTRA+=(-m "not slow")
   elif [[ "$arg" == "--perf-smoke" ]]; then
     PERF_SMOKE=1
+  elif [[ "$arg" == "--analyze" ]]; then
+    exec python -m tosa --json
+  elif [[ "$arg" == "--native-sanitize" ]]; then
+    NATIVE_SANITIZE=1
   else
     EXTRA+=("$arg")
   fi
 done
 
-# lint gate: library modules must not configure logging at import time
-python scripts/check_no_basicconfig.py
+# static-analysis gate: jit purity/host-sync, retry & lock discipline,
+# chaos-obs coverage, import hygiene (rule catalog: docs/analysis.md)
+python -m tosa
 
 export JAX_PLATFORMS=cpu
 if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
   export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+
+if [[ "$NATIVE_SANITIZE" == "1" ]]; then
+  CXX="${CXX:-g++}"
+  if ! command -v "$CXX" >/dev/null 2>&1; then
+    echo "native-sanitize leg SKIPPED: no C++ toolchain ($CXX not found)"
+    exit 0
+  fi
+  SAN_DIR="$(mktemp -d /tmp/tos_native_san.XXXXXX)"
+  trap 'rm -rf "$SAN_DIR"' EXIT
+  echo "native-sanitize leg: building ASan+UBSan libtfrecord_io.so in $SAN_DIR"
+  "$CXX" -O1 -g -fPIC -std=c++17 -shared \
+    -fsanitize=address,undefined -fno-sanitize-recover=all \
+    -o "$SAN_DIR/libtfrecord_io.so" native/tfrecord_io.cc
+  export TOS_NATIVE_LIB="$SAN_DIR/libtfrecord_io.so"
+  # python itself is not ASan-instrumented, so the runtime must be preloaded;
+  # leak checking is off because the interpreter "leaks" by design at exit
+  ASAN_RT="$("$CXX" -print-file-name=libasan.so)"
+  UBSAN_RT="$("$CXX" -print-file-name=libubsan.so)"
+  export LD_PRELOAD="$ASAN_RT $UBSAN_RT"
+  export ASAN_OPTIONS="detect_leaks=0:abort_on_error=1"
+  exec python -m pytest tests/test_native_io.py tests/test_loader_pipeline.py -q \
+    ${EXTRA[@]+"${EXTRA[@]}"}
 fi
 
 if python -c "import pyspark" 2>/dev/null; then

@@ -23,7 +23,7 @@ import secrets
 import threading
 import time as _time
 
-from tensorflowonspark_tpu import TFSparkNode, TFManager, chaos, reservation
+from tensorflowonspark_tpu import TFSparkNode, TFManager, chaos, reservation, resilience
 from tensorflowonspark_tpu.obs import aggregate as obs_aggregate
 from tensorflowonspark_tpu.obs import registry as obs_registry
 
@@ -365,13 +365,13 @@ class TFCluster:
         if unreachable:
             self._shutdown_by_spark_tasks(grace_secs, unreachable)
         errors = []
-        deadline = _time.time() + max(grace_secs, 60)
+        # one absolute budget shared across every channel wait
+        deadline = resilience.Deadline(max(grace_secs, 60))
+        tick = resilience.Backoff(base=0.1, factor=1.0, max_delay=0.1, jitter=0.0)
         for row, mgr in channels:
-            while True:
-                status = mgr.get("child_status")
-                if status is not None or _time.time() > deadline:
+            for _ in tick.attempts(deadline=deadline):
+                if mgr.get("child_status") is not None:
                     break
-                _time.sleep(0.1)
             try:
                 eq = mgr.get_queue("error")
                 if not eq.empty():
@@ -431,9 +431,9 @@ class TFCluster:
 
         self.tf_status.setdefault("error", str(reason))
         reached = _abort_nodes(self._current_rows(), self.cluster_meta["authkey"], reason)
-        deadline = _time.time() + wait_secs
         pending = dict(reached)
-        while pending and _time.time() < deadline:
+        tick = resilience.Backoff(base=0.5, factor=1.0, max_delay=0.5, jitter=0.0)
+        for _ in tick.attempts(deadline=resilience.Deadline(wait_secs)):
             for eid in list(pending):
                 row, mgr = pending[eid]
                 try:
@@ -441,8 +441,8 @@ class TFCluster:
                         pending.pop(eid)
                 except Exception:
                     pending.pop(eid)  # channel gone: the node is down
-            if pending:
-                _time.sleep(0.5)
+            if not pending:
+                break
         for eid, (row, _) in pending.items():
             logger.warning(
                 "abort: node %s:%s did not confirm stop within %ss",
@@ -469,9 +469,11 @@ class TFCluster:
         signal can fire — pass ``timeout`` to bound the wait there.
         """
 
-        deadline = _time.monotonic() + timeout if timeout is not None else None
         mgrs = {}  # keyed by channel address: a task retry re-registers anew
-        while not self.tf_status.get("error"):
+        tick = resilience.Backoff(base=poll_secs, factor=1.0, max_delay=poll_secs, jitter=0.0)
+        for _ in tick.attempts(deadline=resilience.Deadline(timeout)):
+            if self.tf_status.get("error"):
+                return True
             if not self.launch_thread.is_alive():
                 return True
             done = True
@@ -492,10 +494,7 @@ class TFCluster:
                     done = False  # unreachable: rely on launch-thread exit
             if done:
                 return True
-            if deadline is not None and _time.monotonic() > deadline:
-                return False
-            _time.sleep(poll_secs)
-        return True
+        return False
 
     # -- observability --------------------------------------------------------
 

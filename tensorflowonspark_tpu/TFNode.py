@@ -327,17 +327,15 @@ class DataFeed:
         logger.info("DataFeed.terminate: requesting stop of data feed")
         self.mgr.set("state", "terminating")
         queue_in = self.mgr.get_queue(self.qname_in)
-        # drain with a short patience window: feed tasks may still be pushing
-        import time
-
+        # drain with a short patience window: feed tasks may still be pushing,
+        # so the blocking get doubles as the inter-poll pacing
         empty_checks = 0
         while empty_checks < 3:
             try:
-                item = queue_in.get_nowait()
+                item = queue_in.get(timeout=0.1)
                 if _is_shm_chunk(item):
                     item.discard()  # unlink the unread segment
                 queue_in.task_done()
                 empty_checks = 0
             except Exception:
                 empty_checks += 1
-                time.sleep(0.1)

@@ -27,7 +27,7 @@ import os
 import time
 import traceback
 
-from tensorflowonspark_tpu import TFManager, TFNode, chaos, reservation, tpu_info, util
+from tensorflowonspark_tpu import TFManager, TFNode, chaos, reservation, resilience, tpu_info, util
 from tensorflowonspark_tpu.marker import Chunk, EndPartition
 from tensorflowonspark_tpu.obs import aggregate as obs_aggregate
 from tensorflowonspark_tpu.obs import registry as obs_registry
@@ -273,9 +273,11 @@ def _start_heartbeat(mgr):
     import threading
 
     def _beat():
-        n = 0
         failures = 0
-        while True:
+        ticker = resilience.Backoff(
+            base=HEARTBEAT_INTERVAL, factor=1.0, max_delay=HEARTBEAT_INTERVAL, jitter=0.0
+        )
+        for n in ticker.attempts():
             try:
                 mgr.set("heartbeat", n)
                 failures = 0
@@ -286,8 +288,6 @@ def _start_heartbeat(mgr):
                 failures += 1
                 if failures >= 5:
                     return
-            n += 1
-            time.sleep(HEARTBEAT_INTERVAL)
 
     threading.Thread(target=_beat, name="tos-heartbeat", daemon=True).start()
 
@@ -532,7 +532,8 @@ class _NodeLaunchTask:
         import threading
 
         def _watch():
-            while True:
+            ticker = resilience.Backoff(base=1.0, factor=1.0, max_delay=1.0, jitter=0.0)
+            for _ in ticker.attempts():
                 try:
                     if mgr.get("abort") is not None:
                         if child.is_alive():
@@ -550,7 +551,6 @@ class _NodeLaunchTask:
                         return  # node retired through a normal shutdown path
                 except Exception:
                     return  # channel gone: node already shut down
-                time.sleep(1.0)
 
         threading.Thread(
             target=_watch, name="tos-abort-watch-{}-{}".format(job_name, task_index), daemon=True
@@ -730,12 +730,12 @@ class _TrainPartitionTask:
                 logger.info(
                     "fed %d items to queue %r; waiting for consumption", count, self.qname
                 )
-                deadline = time.time() + self.feed_timeout
                 # fine-grained poll at first (a consumer already caught up
                 # finishes the wait in ~ms, which matters for many small
                 # partitions), backing off so long waits don't hammer the proxy
-                poll = 0.002
-                while True:
+                poll = resilience.Backoff(base=0.002, factor=2.0, max_delay=0.1, jitter=0.0)
+                pending = 0
+                for _ in poll.attempts(deadline=resilience.Deadline(self.feed_timeout)):
                     pending = q.unfinished()
                     depth_g.set(pending)
                     if pending <= 0:
@@ -743,14 +743,12 @@ class _TrainPartitionTask:
                     _raise_if_remote_error(mgr)
                     if mgr.get("state") == "terminating":
                         break
-                    if time.time() > deadline:
-                        raise RuntimeError(
-                            "feed timeout: queue {!r} still has {} unconsumed items".format(
-                                self.qname, pending
-                            )
+                else:
+                    raise RuntimeError(
+                        "feed timeout: queue {!r} still has {} unconsumed items".format(
+                            self.qname, pending
                         )
-                    time.sleep(poll)
-                    poll = min(poll * 2, 0.1)
+                    )
         finally:
             try:  # metrics must surface even when the wave times out
                 obs_aggregate.accumulate_to_channel(mgr, reg)
@@ -816,16 +814,15 @@ class _InferencePartitionTask:
                 sp.set(rows=count)
                 if count == 0:
                     return []
-                deadline = time.time() + self.feed_timeout
-                poll = 0.002
-                while q.unfinished() > 0:
+                poll = resilience.Backoff(base=0.002, factor=2.0, max_delay=0.1, jitter=0.0)
+                for _ in poll.attempts(deadline=resilience.Deadline(self.feed_timeout)):
+                    if q.unfinished() <= 0:
+                        break
                     _raise_if_remote_error(mgr)
-                    if time.time() > deadline:
-                        raise RuntimeError(
-                            "inference feed timeout on queue {!r}".format(self.qname_in)
-                        )
-                    time.sleep(poll)
-                    poll = min(poll * 2, 0.1)
+                else:
+                    raise RuntimeError(
+                        "inference feed timeout on queue {!r}".format(self.qname_in)
+                    )
                 from tensorflowonspark_tpu.shm import ShmChunk
 
                 out = mgr.get_queue(self.qname_out)

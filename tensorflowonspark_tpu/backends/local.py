@@ -32,6 +32,8 @@ import uuid
 
 import cloudpickle
 
+from tensorflowonspark_tpu import resilience
+
 logger = logging.getLogger(__name__)
 
 # Spawned (never forked): a LocalSparkContext is routinely created from a
@@ -286,9 +288,10 @@ class LocalStreamingContext:
             # drain queued micro-batches AND wait out the in-flight handler —
             # queue emptiness alone would let shutdown's end-of-feed markers
             # cut off a batch that was dequeued but not yet fully fed
-            deadline = time.time() + 60
-            while not self._queue.empty() and time.time() < deadline:
-                time.sleep(0.1)
+            drain = resilience.Backoff(base=0.1, factor=1.0, max_delay=0.1, jitter=0.0)
+            for _ in drain.attempts(deadline=resilience.Deadline(60)):
+                if self._queue.empty():
+                    break
             with self._busy:
                 pass
         self._stop_ev.set()

@@ -32,7 +32,12 @@ READ_RETRY = resilience.RetryPolicy(
 )
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libtfrecord_io.so")
+#: TOS_NATIVE_LIB points at an alternative build of libtfrecord_io.so —
+#: the sanitizer leg of run_tests.sh uses it to swap in an ASan/UBSan build
+#: without disturbing the checked-in Makefile output
+_LIB_PATH = os.environ.get(
+    "TOS_NATIVE_LIB", os.path.join(_NATIVE_DIR, "libtfrecord_io.so")
+)
 
 _lib = None
 _lib_lock = threading.Lock()

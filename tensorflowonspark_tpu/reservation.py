@@ -407,13 +407,13 @@ class Client:
 
     def await_reservations(self, timeout=600, poll_interval=1.0):
         """Poll until the cluster is complete; returns the full cluster info."""
-        deadline = time.time() + timeout
-        while True:
+        poll = resilience.Backoff(
+            base=poll_interval, factor=1.0, max_delay=poll_interval, jitter=0.0
+        )
+        for _ in poll.attempts(deadline=resilience.Deadline(timeout)):
             if self._request({"type": "QUERY"})["data"]:
                 return self.get_reservations()
-            if time.time() > deadline:
-                raise ReservationError("timed out awaiting full cluster")
-            time.sleep(poll_interval)
+        raise ReservationError("timed out awaiting full cluster")
 
     def request_stop(self):
         self._request({"type": "STOP"})

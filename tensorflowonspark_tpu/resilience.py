@@ -77,6 +77,36 @@ class Backoff:
                 yield capped
             delay = min(delay * self.factor, self.max_delay)
 
+    def attempts(self, deadline=None, sleep=time.sleep):
+        """Yield attempt indices ``0, 1, 2, ...``, sleeping this schedule
+        *between* attempts (never before the first one).
+
+        With a :class:`Deadline`, the generator stops — instead of
+        sleeping — once the budget is spent, and every sleep is clamped so
+        it cannot overshoot. That makes ``for/else`` the natural shape for
+        poll loops: ``break`` on success, the ``else`` branch is the
+        timeout path::
+
+            for _ in Backoff(base=0.1, jitter=0.0).attempts(Deadline(30)):
+                if ready():
+                    break
+            else:
+                raise TimeoutError(...)
+
+        Without a deadline the generator is infinite (a paced ticker).
+        """
+        delays = self.delays()
+        n = 0
+        while True:
+            yield n
+            n += 1
+            if deadline is not None:
+                if deadline.expired():
+                    return
+                sleep(deadline.clamp(next(delays)))
+            else:
+                sleep(next(delays))
+
     def __repr__(self):
         return "Backoff(base={}, factor={}, max_delay={}, jitter={}, seed={})".format(
             self.base, self.factor, self.max_delay, self.jitter, self.seed

@@ -30,8 +30,8 @@ def setup_logging(level=logging.INFO):
     bench.py, the jax child process). Libraries must never do this at import
     time — importing :mod:`tensorflowonspark_tpu` leaves the root logger's
     handlers untouched so embedding applications keep control of their own
-    logging (enforced by scripts/check_no_basicconfig.py and a regression
-    test). No-op if the root logger is already configured."""
+    logging (enforced by the ``import-hygiene`` rule of ``python -m tosa``
+    and a regression test). No-op if the root logger is already configured."""
     logging.basicConfig(level=level, format=LOG_FORMAT)
 
 

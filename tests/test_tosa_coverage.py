@@ -1,0 +1,193 @@
+"""Fixture tests for the chaos-obs-coverage and import-hygiene rules."""
+
+import textwrap
+
+from tosa_testutil import run_rule, run_rule_multi
+
+
+def _src(s):
+    return textwrap.dedent(s).lstrip()
+
+
+CHAOS_PATH = "tensorflowonspark_tpu/chaos/__init__.py"
+
+#: a minimal chaos module: one documented site + the obs counter bump
+CHAOS_MODULE = _src('''
+    """Deterministic fault injection.
+
+    Sites:
+
+    ``feed.stall``      delay the feeder before a put
+    """
+
+    from tensorflowonspark_tpu import obs
+
+    active = False
+
+
+    def _record(site):
+        obs.counter("chaos_faults_injected_total").inc()
+
+
+    def fire(site):
+        _record(site)
+
+
+    def delay(site, seconds=0.0):
+        _record(site)
+''')
+
+FIRING_MODULE = _src("""
+    from tensorflowonspark_tpu import chaos
+
+
+    def feed(q, item):
+        if chaos.active:
+            chaos.fire("feed.stall")
+        q.put(item)
+""")
+
+
+class TestChaosObsCoverage:
+    def test_documented_and_fired_is_clean(self):
+        findings = run_rule_multi("chaos-obs-coverage", {
+            CHAOS_PATH: CHAOS_MODULE,
+            "tensorflowonspark_tpu/feeder.py": FIRING_MODULE,
+        })
+        assert findings == []
+
+    def test_non_literal_site_fires(self):
+        findings = run_rule_multi("chaos-obs-coverage", {
+            CHAOS_PATH: CHAOS_MODULE,
+            "tensorflowonspark_tpu/feeder.py": _src("""
+                from tensorflowonspark_tpu import chaos
+
+                SITE = "feed.stall"
+
+
+                def feed(q, item):
+                    chaos.fire(SITE)
+                    chaos.delay("feed.stall")
+                    q.put(item)
+            """),
+        })
+        assert len(findings) == 1
+        assert "non-literal" in findings[0].message
+
+    def test_undocumented_site_fires(self):
+        findings = run_rule_multi("chaos-obs-coverage", {
+            CHAOS_PATH: CHAOS_MODULE,
+            "tensorflowonspark_tpu/feeder.py": _src("""
+                from tensorflowonspark_tpu import chaos
+
+
+                def feed(q, item):
+                    chaos.fire("feed.stall")
+                    chaos.fire("feed.mystery")
+                    q.put(item)
+            """),
+        })
+        assert len(findings) == 1
+        assert "feed.mystery" in findings[0].message
+        assert "missing from the site table" in findings[0].message
+
+    def test_stale_table_row_fires(self):
+        stale = CHAOS_MODULE.replace(
+            "``feed.stall``      delay the feeder before a put",
+            "``feed.stall``      delay the feeder before a put\n"
+            "    ``feed.ghost``      documented but never wired up",
+        )
+        findings = run_rule_multi("chaos-obs-coverage", {
+            CHAOS_PATH: stale,
+            "tensorflowonspark_tpu/feeder.py": FIRING_MODULE,
+        })
+        assert len(findings) == 1
+        assert "feed.ghost" in findings[0].message
+        assert "never fired" in findings[0].message
+
+    def test_missing_obs_counter_fires(self):
+        no_counter = CHAOS_MODULE.replace(
+            'obs.counter("chaos_faults_injected_total").inc()', "pass"
+        )
+        findings = run_rule_multi("chaos-obs-coverage", {
+            CHAOS_PATH: no_counter,
+            "tensorflowonspark_tpu/feeder.py": FIRING_MODULE,
+        })
+        assert len(findings) == 1
+        assert "chaos_faults_injected_total" in findings[0].message
+
+    def test_no_chaos_module_in_scan_skips_table_checks(self):
+        findings = run_rule_multi("chaos-obs-coverage", {
+            "tensorflowonspark_tpu/feeder.py": FIRING_MODULE,
+        })
+        assert findings == []
+
+
+class TestImportHygiene:
+    def test_module_level_basicconfig_fires(self):
+        findings = run_rule("import-hygiene", _src("""
+            import logging
+
+            logging.basicConfig(level=logging.INFO)
+        """))
+        assert len(findings) == 1
+        assert "setup_logging" in findings[0].message
+
+    def test_class_body_counts_as_import_time(self):
+        findings = run_rule("import-hygiene", _src("""
+            import jax
+
+
+            class Topology:
+                DEVICES = jax.devices()
+        """))
+        assert len(findings) == 1
+        assert "jax.devices" in findings[0].message
+
+    def test_module_level_jax_distributed_init_fires(self):
+        findings = run_rule("import-hygiene", _src("""
+            import jax
+
+            jax.distributed.initialize()
+        """))
+        assert len(findings) == 1
+
+    def test_spark_session_chain_fires(self):
+        findings = run_rule("import-hygiene", _src("""
+            from pyspark.sql import SparkSession
+
+            spark = SparkSession.builder.appName("x").getOrCreate()
+        """))
+        assert len(findings) == 1
+
+    def test_spark_context_constructor_fires(self):
+        findings = run_rule("import-hygiene", _src("""
+            from pyspark import SparkContext
+
+            sc = SparkContext()
+        """))
+        assert len(findings) == 1
+
+    def test_calls_inside_functions_are_clean(self):
+        findings = run_rule("import-hygiene", _src("""
+            import logging
+
+            import jax
+
+
+            def setup_logging(level=logging.INFO):
+                logging.basicConfig(level=level)
+
+
+            def world_size():
+                return jax.device_count()
+        """))
+        assert findings == []
+
+    def test_scripts_are_not_library_scope(self):
+        findings = run_rule("import-hygiene", _src("""
+            import logging
+
+            logging.basicConfig(level=logging.INFO)
+        """), relpath="scripts/bench_helper.py")
+        assert findings == []

@@ -1,0 +1,234 @@
+"""Fixture tests for the retry-discipline and lock-discipline rules."""
+
+import textwrap
+
+from tosa_testutil import run_rule
+
+
+def _src(s):
+    return textwrap.dedent(s).lstrip()
+
+
+class TestRetryDiscipline:
+    def test_sleep_in_while_loop_fires(self):
+        findings = run_rule("retry-discipline", _src("""
+            import time
+
+            def wait(q):
+                while q.empty():
+                    time.sleep(0.1)
+        """))
+        assert len(findings) == 1
+        assert "resilience" in findings[0].message
+
+    def test_aliased_import_fires(self):
+        findings = run_rule("retry-discipline", _src("""
+            import time as _time
+
+            def wait(n):
+                for _ in range(n):
+                    _time.sleep(0.5)
+        """))
+        assert len(findings) == 1
+
+    def test_from_import_sleep_fires(self):
+        findings = run_rule("retry-discipline", _src("""
+            from time import sleep as snooze
+
+            def wait(n):
+                for _ in range(n):
+                    snooze(1)
+        """))
+        assert len(findings) == 1
+
+    def test_sleep_outside_loop_is_clean(self):
+        findings = run_rule("retry-discipline", _src("""
+            import time
+
+            def settle():
+                time.sleep(0.2)
+        """))
+        assert findings == []
+
+    def test_resilience_module_is_exempt(self):
+        findings = run_rule("retry-discipline", _src("""
+            import time
+
+            def attempts():
+                while True:
+                    time.sleep(0.1)
+        """), relpath="tensorflowonspark_tpu/resilience.py")
+        assert findings == []
+
+    def test_backoff_attempts_loop_is_clean(self):
+        findings = run_rule("retry-discipline", _src("""
+            from tensorflowonspark_tpu import resilience
+
+            def wait(ready):
+                tick = resilience.Backoff(base=0.1, jitter=0.0)
+                for _ in tick.attempts(deadline=resilience.Deadline(30)):
+                    if ready():
+                        break
+                else:
+                    raise TimeoutError("not ready")
+        """))
+        assert findings == []
+
+    def test_function_defined_in_loop_is_clean(self):
+        # the def boundary resets loop ancestry: the sleep runs when the
+        # callback is invoked, not per loop iteration
+        findings = run_rule("retry-discipline", _src("""
+            import time
+
+            def make_callbacks(n):
+                out = []
+                for _ in range(n):
+                    def cb():
+                        time.sleep(0.1)
+                    out.append(cb)
+                return out
+        """))
+        assert findings == []
+
+
+class TestLockDiscipline:
+    def test_unlocked_cross_thread_write_fires(self):
+        findings = run_rule("lock-discipline", _src("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.count = 0
+                    self._lock = threading.Lock()
+
+                def start(self):
+                    threading.Thread(target=self._run, daemon=True).start()
+
+                def _run(self):
+                    self.count = 1
+
+                def bump(self):
+                    self.count = 2
+        """))
+        assert len(findings) == 2  # both unlocked writes are reported
+        assert all("self.count" in f.message for f in findings)
+
+    def test_locked_writes_are_clean(self):
+        findings = run_rule("lock-discipline", _src("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.count = 0
+                    self._lock = threading.Lock()
+
+                def start(self):
+                    threading.Thread(target=self._run, daemon=True).start()
+
+                def _run(self):
+                    with self._lock:
+                        self.count = 1
+
+                def bump(self):
+                    with self._lock:
+                        self.count = 2
+        """))
+        assert findings == []
+
+    def test_single_thread_ownership_is_clean(self):
+        # only the spawned thread writes the attr after __init__: no race
+        findings = run_rule("lock-discipline", _src("""
+            import threading
+
+            class Ticker:
+                def __init__(self):
+                    self.ticks = 0
+
+                def start(self):
+                    threading.Thread(target=self._run, daemon=True).start()
+
+                def _run(self):
+                    self.ticks = self.ticks + 1
+        """))
+        assert findings == []
+
+    def test_transitive_thread_reachability_fires(self):
+        # _run calls _step; _step's write races with the main-group write
+        findings = run_rule("lock-discipline", _src("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.state = "new"
+
+                def start(self):
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    self._step()
+
+                def _step(self):
+                    self.state = "running"
+
+                def stop(self):
+                    self.state = "stopped"
+        """))
+        assert len(findings) == 2
+
+    def test_executor_submit_counts_as_thread_entry(self):
+        findings = run_rule("lock-discipline", _src("""
+            class Pool:
+                def __init__(self, ex):
+                    self._ex = ex
+                    self.done = 0
+
+                def kick(self):
+                    self._ex.submit(self._work)
+
+                def _work(self):
+                    self.done = 1
+
+                def reset(self):
+                    self.done = 0
+        """))
+        assert len(findings) == 2
+
+    def test_dict_store_is_exempt(self):
+        # self.d[k] = v is a single GIL-atomic store; no read-modify-write
+        findings = run_rule("lock-discipline", _src("""
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self.data = {}
+
+                def start(self):
+                    threading.Thread(target=self._fill).start()
+
+                def _fill(self):
+                    self.data["a"] = 1
+
+                def put(self, k, v):
+                    self.data[k] = v
+        """))
+        assert findings == []
+
+    def test_subscript_augassign_fires(self):
+        # self.d[k] += 1 IS a read-modify-write and needs the lock
+        findings = run_rule("lock-discipline", _src("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self.counts = {}
+
+                def start(self):
+                    threading.Thread(target=self._drain).start()
+
+                def _drain(self):
+                    self.counts["n"] += 1
+
+                def bump(self):
+                    self.counts["n"] += 1
+        """))
+        assert len(findings) == 2

@@ -1,0 +1,175 @@
+"""Framework-level tests for tosa: suppressions, baseline workflow, the
+CLI contract, and the self-run gate asserting this repo is clean."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from tosa_testutil import REPO_ROOT, run_rule
+from tosa import ALL_CHECKERS, analyze_source, core, make_checkers
+
+
+def _src(s):
+    return textwrap.dedent(s).lstrip()
+
+
+BAD_SLEEP = _src("""
+    import time
+
+    def wait(q):
+        while q.empty():
+            time.sleep(0.1)
+""")
+
+
+class TestSuppressions:
+    def test_inline_disable_silences_with_reason(self):
+        src = BAD_SLEEP.replace(
+            "time.sleep(0.1)",
+            "time.sleep(0.1)  # tosa: disable=retry-discipline -- fixture needs a raw sleep",
+        )
+        findings = analyze_source(src, "mod.py", make_checkers(["retry-discipline"]))
+        assert len(findings) == 1
+        assert findings[0].suppressed == "fixture needs a raw sleep"
+        assert core.gating(findings) == []
+
+    def test_disable_of_other_rule_does_not_silence(self):
+        src = BAD_SLEEP.replace(
+            "time.sleep(0.1)",
+            "time.sleep(0.1)  # tosa: disable=jit-purity -- wrong rule",
+        )
+        findings = analyze_source(src, "mod.py", make_checkers(["retry-discipline"]))
+        assert len(core.gating(findings)) == 1
+
+    def test_disable_all_silences_everything(self):
+        src = BAD_SLEEP.replace(
+            "time.sleep(0.1)",
+            "time.sleep(0.1)  # tosa: disable=all -- kitchen sink",
+        )
+        findings = analyze_source(src, "mod.py", make_checkers(["retry-discipline"]))
+        assert core.gating(findings) == []
+
+
+class TestBaseline:
+    def test_baselined_finding_does_not_gate(self, tmp_path):
+        findings = analyze_source(BAD_SLEEP, "mod.py", make_checkers(["retry-discipline"]))
+        assert len(core.gating(findings)) == 1
+        bl = tmp_path / "baseline.json"
+        core.write_baseline(str(bl), findings)
+        fresh = analyze_source(BAD_SLEEP, "mod.py", make_checkers(["retry-discipline"]))
+        fresh = core.apply_baseline(fresh, core.load_baseline(str(bl)))
+        assert core.gating(fresh) == []
+        assert all(f.baselined for f in fresh)
+
+    def test_fingerprint_is_line_free(self):
+        shifted = "# a leading comment\n# another\n" + BAD_SLEEP
+        a = analyze_source(BAD_SLEEP, "mod.py", make_checkers(["retry-discipline"]))
+        b = analyze_source(shifted, "mod.py", make_checkers(["retry-discipline"]))
+        assert a[0].line != b[0].line
+        assert a[0].fingerprint == b[0].fingerprint
+
+    def test_baseline_allowance_is_counted(self):
+        # one baseline entry grandfathers ONE occurrence; a second identical
+        # finding still gates
+        doubled = BAD_SLEEP.replace(
+            "time.sleep(0.1)", "time.sleep(0.1)\n        time.sleep(0.1)"
+        )
+        findings = analyze_source(doubled, "mod.py", make_checkers(["retry-discipline"]))
+        assert len(findings) == 2
+        baseline = {findings[0].fingerprint: 1}
+        findings = core.apply_baseline(findings, baseline)
+        assert len(core.gating(findings)) == 1
+
+
+class TestRegistry:
+    def test_all_six_rules_registered(self):
+        assert set(ALL_CHECKERS) == {
+            "jit-host-sync", "jit-purity", "retry-discipline",
+            "lock-discipline", "chaos-obs-coverage", "import-hygiene",
+        }
+
+    def test_unknown_rule_fails_loudly(self):
+        try:
+            make_checkers(["no-such-rule"])
+        except KeyError as e:
+            assert "no-such-rule" in e.args[0]
+        else:
+            raise AssertionError("expected KeyError")
+
+    def test_parse_error_is_reported_not_raised(self):
+        findings = analyze_source("def broken(:\n", "mod.py", make_checkers())
+        assert len(findings) == 1
+        assert findings[0].rule == "parse-error"
+
+
+def _run_cli(args, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, "-m", "tosa"] + args,
+        cwd=cwd,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+class TestCLI:
+    def test_json_report_and_exit_code(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(BAD_SLEEP)
+        proc = _run_cli(
+            ["--json", "--root", str(tmp_path), "--baseline", str(tmp_path / "bl.json"), str(bad)]
+        )
+        assert proc.returncode == 1, proc.stderr
+        report = json.loads(proc.stdout)
+        assert report["gating"] == 1
+        assert report["files_analyzed"] == 1
+        [finding] = report["findings"]
+        assert finding["rule"] == "retry-discipline"
+        assert finding["path"] == "bad.py"
+
+    def test_write_baseline_then_clean(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(BAD_SLEEP)
+        bl = tmp_path / "bl.json"
+        args = ["--root", str(tmp_path), "--baseline", str(bl), str(bad)]
+        proc = _run_cli(["--write-baseline"] + args)
+        assert proc.returncode == 0, proc.stderr
+        assert json.loads(bl.read_text())["findings"]
+        proc = _run_cli(args)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "1 baselined" in proc.stdout
+
+    def test_rules_filter_runs_only_selected(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(BAD_SLEEP)
+        proc = _run_cli(
+            ["--rules", "import-hygiene", "--root", str(tmp_path),
+             "--baseline", str(tmp_path / "bl.json"), str(bad)]
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_unknown_rule_is_usage_error(self):
+        proc = _run_cli(["--rules", "bogus"])
+        assert proc.returncode == 2
+        assert "unknown rule" in proc.stderr
+
+    def test_list_rules_covers_catalog(self):
+        proc = _run_cli(["--list-rules"])
+        assert proc.returncode == 0
+        for rule in ALL_CHECKERS:
+            assert rule in proc.stdout
+
+
+class TestSelfRun:
+    def test_repo_is_clean_under_all_rules(self):
+        """The hard gate: the analyzer over its default targets (library,
+        bench.py, scripts) finds nothing to report — every invariant the
+        six rules encode holds in this repo, with an empty baseline."""
+        proc = _run_cli([])
+        assert proc.returncode == 0, "\n" + proc.stdout + proc.stderr
+
+    def test_committed_baseline_is_empty(self):
+        with open(os.path.join(REPO_ROOT, "tools", "analyze", "baseline.json")) as f:
+            assert json.load(f) == {"findings": []}

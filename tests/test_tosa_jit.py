@@ -1,0 +1,165 @@
+"""Fixture tests for the jit-host-sync and jit-purity rules: each bad
+snippet must fire, each good twin must stay clean — proving the rule is
+live, not vacuously passing on the repo."""
+
+import textwrap
+
+from tosa_testutil import run_rule
+
+
+def _src(s):
+    return textwrap.dedent(s).lstrip()
+
+
+class TestJitHostSync:
+    def test_item_inside_jit_fires(self):
+        findings = run_rule("jit-host-sync", _src("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                y = x * 2
+                return y.item()
+        """))
+        assert len(findings) == 1
+        assert "item" in findings[0].message
+        assert findings[0].line == 6
+
+    def test_float_builtin_inside_pjit_fires(self):
+        findings = run_rule("jit-host-sync", _src("""
+            from jax.experimental.pjit import pjit
+
+            @pjit
+            def step(x):
+                loss = x.sum()
+                return float(loss)
+        """))
+        assert len(findings) == 1
+
+    def test_block_until_ready_in_wrapped_fn_fires(self):
+        findings = run_rule("jit-host-sync", _src("""
+            import jax
+
+            def step(x):
+                return (x + 1).block_until_ready()
+
+            fast_step = jax.jit(step)
+        """))
+        assert len(findings) == 1
+
+    def test_sync_outside_traced_code_is_clean(self):
+        findings = run_rule("jit-host-sync", _src("""
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x * 2
+
+            def host_loop(x):
+                out = step(x)
+                return float(out.item())
+        """))
+        assert findings == []
+
+    def test_pure_shard_map_body_is_clean(self):
+        findings = run_rule("jit-host-sync", _src("""
+            import functools
+            import jax
+            from jax.experimental.shard_map import shard_map
+
+            def body(x):
+                return jax.lax.psum(x, "i")
+
+            mapped = shard_map(functools.partial(body), mesh=None, in_specs=(), out_specs=())
+        """))
+        assert findings == []
+
+
+class TestJitPurity:
+    def test_obs_counter_inside_jit_fires(self):
+        findings = run_rule("jit-purity", _src("""
+            import jax
+            from tensorflowonspark_tpu import obs
+
+            @jax.jit
+            def step(state, x):
+                obs.counter("steps_total").inc()
+                return state + x
+        """))
+        assert len(findings) == 1
+        assert "obs.counter" in findings[0].message
+
+    def test_closure_mutation_inside_jit_fires(self):
+        findings = run_rule("jit-purity", _src("""
+            import jax
+
+            stats = {}
+
+            @jax.jit
+            def step(x):
+                stats["last"] = x
+                return x
+        """))
+        assert len(findings) == 1
+        assert "stats" in findings[0].message
+
+    def test_wall_clock_inside_jit_fires(self):
+        findings = run_rule("jit-purity", _src("""
+            import jax
+            import time
+
+            @jax.jit
+            def step(x):
+                t0 = time.time()
+                return x + t0
+        """))
+        assert len(findings) == 1
+        assert "time.time" in findings[0].message
+
+    def test_global_statement_inside_jit_fires(self):
+        findings = run_rule("jit-purity", _src("""
+            import jax
+
+            count = 0
+
+            @jax.jit
+            def step(x):
+                global count
+                count = count + 1
+                return x
+        """))
+        assert any("global" in f.message for f in findings)
+
+    def test_pure_step_with_local_mutation_is_clean(self):
+        # mutating values the function itself binds is fine: that's not
+        # closed-over state, it's how jaxprs are built up
+        findings = run_rule("jit-purity", _src("""
+            import jax
+
+            @jax.jit
+            def step(state, batch):
+                acc = {}
+                acc["loss"] = (state - batch).sum()
+                new_state = state - 0.1 * batch
+                return new_state, acc
+        """))
+        assert findings == []
+
+    def test_effects_in_host_loop_are_clean(self):
+        findings = run_rule("jit-purity", _src("""
+            import jax
+            import time
+            from tensorflowonspark_tpu import obs
+
+            @jax.jit
+            def step(x):
+                return x * 2
+
+            def train(xs):
+                t0 = time.time()
+                for x in xs:
+                    step(x)
+                    obs.counter("steps_total").inc()
+                return time.time() - t0
+        """))
+        assert findings == []

@@ -1,0 +1,39 @@
+"""Shared helpers for the tosa analyzer tests.
+
+``tosa`` lives at ``tools/analyze/tosa`` with a repo-root symlink, so
+putting the repo root on ``sys.path`` makes ``import tosa`` work the same
+way ``python -m tosa`` does from a checkout.
+"""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tosa import analyze_source, core, make_checkers  # noqa: E402
+
+#: default fixture path — inside the library so library-scoped rules apply
+LIB_PATH = "tensorflowonspark_tpu/fixture_mod.py"
+
+
+def run_rule(rule, source, relpath=LIB_PATH):
+    """Analyze one in-memory file under a single rule; unsuppressed
+    findings only (what would gate)."""
+    findings = analyze_source(source, relpath, make_checkers([rule]))
+    return [f for f in findings if f.suppressed is None]
+
+
+def run_rule_multi(rule, files):
+    """Analyze several in-memory files (``{relpath: source}``) under one
+    rule, including the cross-file ``end_run`` pass."""
+    checkers = make_checkers([rule])
+    run = core.RunContext()
+    findings = []
+    for relpath, source in files.items():
+        findings.extend(analyze_source(source, relpath, checkers, run=run))
+    for checker in checkers:
+        checker.end_run(run)
+    findings.extend(run.findings)
+    return [f for f in findings if f.suppressed is None]

@@ -1,0 +1,53 @@
+"""tosa — TensorFlowOnSpark-TPU static analyzer.
+
+An AST-based invariant checker for this repository: one parse and one
+tree walk per file, with rules as plugins (see ``tosa.checkers``).
+
+Usage::
+
+    python -m tosa                      # analyze the default targets
+    python -m tosa --rules jit-purity,retry-discipline path/to/file.py
+    python -m tosa --json               # machine-readable report
+    python -m tosa --write-baseline     # grandfather current findings
+    python -m tosa --list-rules
+
+Rules enforced (details in ``docs/analysis.md``):
+
+==================  =======================================================
+jit-host-sync       no host synchronization inside jit/pjit/shard_map
+jit-purity          traced functions are pure (no effects, clocks, mutation)
+retry-discipline    no bare time.sleep in loops; use resilience primitives
+lock-discipline     cross-thread attribute writes are lock-guarded
+chaos-obs-coverage  chaos sites literal, documented, and obs-counted
+import-hygiene      importing the library has no side effects
+==================  =======================================================
+
+Findings print as ``file:line: [rule] message``. Silence a single line
+with ``# tosa: disable=<rule> -- <reason>``; grandfather existing debt
+with ``--write-baseline`` (committed at ``tools/analyze/baseline.json``).
+"""
+
+from . import core
+from .checkers import ALL_CHECKERS, make_checkers
+from .core import (
+    Checker,
+    Finding,
+    analyze_files,
+    analyze_source,
+    gating,
+    iter_python_files,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ALL_CHECKERS",
+    "Checker",
+    "Finding",
+    "analyze_files",
+    "analyze_source",
+    "core",
+    "gating",
+    "iter_python_files",
+    "make_checkers",
+]

@@ -1,0 +1,148 @@
+"""CLI for the tosa analyzer: ``python -m tosa [targets...]``.
+
+Exit status is 0 when every finding is either inline-suppressed or
+covered by the baseline, 1 when unsuppressed findings remain, 2 on usage
+errors — so ``python -m tosa`` works directly as a CI gate.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from . import __version__, core
+from .checkers import ALL_CHECKERS, make_checkers
+
+#: what a bare ``python -m tosa`` analyzes, relative to the repo root
+DEFAULT_TARGETS = ("tensorflowonspark_tpu", "bench.py", "scripts")
+
+BASELINE_RELPATH = os.path.join("tools", "analyze", "baseline.json")
+
+
+def find_root(start):
+    """Walk up from ``start`` to the repo root (pyproject.toml or .git)."""
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.isfile(os.path.join(cur, "pyproject.toml")) or os.path.isdir(
+            os.path.join(cur, ".git")
+        ):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m tosa",
+        description="AST-based invariant analyzer for tensorflowonspark_tpu",
+    )
+    p.add_argument(
+        "targets",
+        nargs="*",
+        help="files or directories to analyze (default: {})".format(
+            ", ".join(DEFAULT_TARGETS)
+        ),
+    )
+    p.add_argument(
+        "--rules",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument("--json", action="store_true", help="emit a JSON report")
+    p.add_argument(
+        "--baseline",
+        help="baseline file (default: <root>/{})".format(
+            BASELINE_RELPATH.replace(os.sep, "/")
+        ),
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="grandfather every current finding into the baseline and exit 0",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    p.add_argument(
+        "--root",
+        help="repo root for relative paths and default targets "
+        "(default: auto-detected from cwd)",
+    )
+    p.add_argument(
+        "--version", action="version", version="tosa {}".format(__version__)
+    )
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        width = max(len(r) for r in ALL_CHECKERS)
+        for rule in sorted(ALL_CHECKERS):
+            print("{:<{}}  {}".format(rule, width, ALL_CHECKERS[rule].description))
+        return 0
+
+    root = os.path.abspath(args.root) if args.root else find_root(os.getcwd())
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        checkers = make_checkers(rules)
+    except KeyError as e:
+        print("tosa: {}".format(e.args[0]), file=sys.stderr)
+        return 2
+
+    targets = args.targets or [
+        os.path.join(root, t) for t in DEFAULT_TARGETS if os.path.exists(os.path.join(root, t))
+    ]
+    paths = core.iter_python_files(targets)
+    if not paths:
+        print("tosa: no python files under: {}".format(", ".join(targets)), file=sys.stderr)
+        return 2
+
+    findings = core.analyze_files(paths, checkers, root=root)
+
+    baseline_path = args.baseline or os.path.join(root, BASELINE_RELPATH)
+    if args.write_baseline:
+        core.write_baseline(baseline_path, findings)
+        print(
+            "tosa: wrote {} fingerprint(s) to {}".format(
+                len([f for f in findings if f.suppressed is None]),
+                os.path.relpath(baseline_path, root),
+            )
+        )
+        return 0
+
+    findings = core.apply_baseline(findings, core.load_baseline(baseline_path))
+    gate = core.gating(findings)
+
+    if args.json:
+        report = {
+            "version": __version__,
+            "rules": sorted(c.rule for c in checkers),
+            "files_analyzed": len(paths),
+            "findings": [f.to_dict() for f in findings],
+            "gating": len(gate),
+        }
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            if f.suppressed is not None or f.baselined:
+                continue
+            print("{}:{}: [{}] {}".format(f.path, f.line, f.rule, f.message))
+        suppressed = sum(1 for f in findings if f.suppressed is not None)
+        baselined = sum(1 for f in findings if f.baselined)
+        print(
+            "tosa: {} file(s), {} finding(s) "
+            "({} suppressed, {} baselined, {} gating)".format(
+                len(paths), len(findings), suppressed, baselined, len(gate)
+            )
+        )
+    return 1 if gate else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

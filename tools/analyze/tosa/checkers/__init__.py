@@ -1,0 +1,45 @@
+"""Checker registry: rule id -> checker class.
+
+Adding a rule is: write a ``core.Checker`` subclass in this package and
+register it here; the CLI, ``--rules`` filtering, ``--list-rules`` and
+suppression validation all read from this one table.
+"""
+
+from .chaos_obs import ChaosObsChecker
+from .import_hygiene import ImportHygieneChecker
+from .jit_host_sync import JitHostSyncChecker
+from .jit_purity import JitPurityChecker
+from .lock_discipline import LockDisciplineChecker
+from .retry_discipline import RetryDisciplineChecker
+
+ALL_CHECKERS = {
+    cls.rule: cls
+    for cls in (
+        JitHostSyncChecker,
+        JitPurityChecker,
+        RetryDisciplineChecker,
+        LockDisciplineChecker,
+        ChaosObsChecker,
+        ImportHygieneChecker,
+    )
+}
+
+
+def make_checkers(rules=None):
+    """Instantiate the selected checkers (all of them by default).
+
+    Raises ``KeyError`` listing unknown rule ids, so a typo in ``--rules``
+    fails loudly instead of silently checking nothing.
+    """
+    if rules is None:
+        selected = list(ALL_CHECKERS)
+    else:
+        unknown = sorted(set(rules) - set(ALL_CHECKERS))
+        if unknown:
+            raise KeyError(
+                "unknown rule(s): {} (known: {})".format(
+                    ", ".join(unknown), ", ".join(sorted(ALL_CHECKERS))
+                )
+            )
+        selected = list(rules)
+    return [ALL_CHECKERS[r]() for r in selected]

@@ -1,0 +1,99 @@
+"""Shared discovery of jit-traced functions in one module.
+
+Both jit checkers need the same answer: *which function bodies in this file
+execute under a JAX trace?* Tracing is what makes host syncs and impurity
+wrong (Frostig et al. 2018: a traced function runs once to build a jaxpr;
+side effects happen at trace time, host syncs force a device round-trip
+inside the compiled step). A function is considered traced when it is:
+
+- decorated with ``jax.jit`` / ``jit`` / ``pjit`` / ``shard_map`` (bare,
+  called, or via ``functools.partial(jax.jit, ...)``), or
+- passed as the first argument to a ``jit``/``pjit``/``shard_map`` call
+  anywhere in the module (``train_step = jax.jit(step)``), directly, as a
+  lambda, or wrapped in ``functools.partial(fn, ...)``.
+
+Nested defs inside a traced function are traced too; callers walk the whole
+subtree. Functions only reachable *dynamically* (a name imported from
+another module and jitted here) are out of scope — this is a per-file
+analysis, deliberately cheap enough to run on every test invocation.
+"""
+
+import ast
+
+from .. import core
+
+#: callee suffixes that trace their function argument
+JIT_WRAPPERS = ("jit", "pjit", "shard_map")
+#: callee suffixes that forward their first argument (unwrapped recursively)
+PARTIAL_WRAPPERS = ("partial",)
+
+
+def _ends_with(name, suffixes):
+    if not name:
+        return False
+    last = name.rsplit(".", 1)[-1]
+    return last in suffixes
+
+
+def _unwrap_partial(node):
+    """``functools.partial(fn, ...)`` -> ``fn`` (recursively)."""
+    while (
+        isinstance(node, ast.Call)
+        and _ends_with(core.dotted_name(node.func), PARTIAL_WRAPPERS)
+        and node.args
+    ):
+        node = node.args[0]
+    return node
+
+
+def _is_jit_decorator(dec):
+    """``@jax.jit``, ``@jit(static_argnums=...)``, ``@partial(jax.jit, ...)``."""
+    if _ends_with(core.dotted_name(dec), JIT_WRAPPERS):
+        return True
+    if isinstance(dec, ast.Call):
+        if _ends_with(core.dotted_name(dec.func), JIT_WRAPPERS):
+            return True
+        inner = _unwrap_partial(dec)
+        if inner is not dec and _ends_with(core.dotted_name(inner), JIT_WRAPPERS):
+            return True
+        if (
+            _ends_with(core.dotted_name(dec.func), PARTIAL_WRAPPERS)
+            and dec.args
+            and _ends_with(core.dotted_name(dec.args[0]), JIT_WRAPPERS)
+        ):
+            return True
+    return False
+
+
+def traced_functions(tree):
+    """[(function node, reason string)] for every traced def/lambda."""
+    defs_by_name = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    traced = {}  # id(node) -> (node, reason)
+
+    def mark(node, reason):
+        traced.setdefault(id(node), (node, reason))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_decorator(dec):
+                    mark(node, "decorated @{}".format(core.dotted_name(dec) or "jit"))
+        if isinstance(node, ast.Call) and _ends_with(
+            core.dotted_name(node.func), JIT_WRAPPERS
+        ):
+            if not node.args:
+                continue
+            wrapper = core.dotted_name(node.func)
+            target = _unwrap_partial(node.args[0])
+            if isinstance(target, ast.Lambda):
+                mark(target, "lambda passed to {}".format(wrapper))
+            else:
+                name = core.dotted_name(target)
+                if name and "." not in name:
+                    for d in defs_by_name.get(name, []):
+                        mark(d, "passed to {}".format(wrapper))
+    return list(traced.values())

@@ -1,0 +1,117 @@
+"""chaos-obs-coverage: fault-injection sites stay documented and observable.
+
+The chaos subsystem (PR 2) is only trustworthy if three invariants hold:
+
+1. Every ``chaos.fire("site")`` / ``chaos.delay("site", ...)`` call uses a
+   **literal** site id — computed ids can't be audited or targeted from a
+   ``TOS_CHAOS_PLAN``.
+2. Every fired site appears in the site table of ``chaos/__init__.py``'s
+   module docstring (lines of the form ```` ``site.id``  effect ````), and
+   every documented site is actually fired somewhere — the table is the
+   contract operators read when writing plans, so drift in either
+   direction is a bug.
+3. The chaos module increments the ``chaos_faults_injected_total`` obs
+   counter, so injected faults are visible in the metrics pipeline.
+
+Checks 2 and 3 are cross-file and run at ``end_run``; they are skipped
+when no ``chaos/__init__.py`` is part of the scanned set (fixture runs).
+"""
+
+import ast
+import re
+
+from .. import core
+
+CHAOS_FUNCS = ("fire", "delay")
+SITE_LINE_RE = re.compile(r"^\s*``(?P<site>[A-Za-z0-9_.]+)``\s{2,}\S")
+COUNTER_NAME = "chaos_faults_injected_total"
+
+
+def _is_chaos_module(relpath):
+    return relpath.replace("\\", "/").endswith("chaos/__init__.py")
+
+
+class ChaosObsChecker(core.Checker):
+    rule = "chaos-obs-coverage"
+    description = (
+        "chaos.fire/delay sites must be literal, documented in the chaos "
+        "site table, and counted via obs"
+    )
+    interests = (ast.Call,)
+
+    def __init__(self):
+        self._fired = {}          # site -> (relpath, lineno) first occurrence
+        self._table = None        # None until chaos/__init__.py is scanned
+        self._table_anchor = None  # (relpath, lineno) of the docstring
+        self._counter_seen = False
+
+    def begin_file(self, ctx):
+        if _is_chaos_module(ctx.relpath):
+            self._scan_chaos_module(ctx)
+
+    def _scan_chaos_module(self, ctx):
+        doc = ast.get_docstring(ctx.tree) or ""
+        self._table = {}
+        anchor_line = ctx.tree.body[0].lineno if ctx.tree.body else 1
+        self._table_anchor = (ctx.relpath, anchor_line)
+        for line in doc.splitlines():
+            m = SITE_LINE_RE.match(line)
+            if m:
+                self._table[m.group("site")] = line.strip()
+        if COUNTER_NAME in ctx.source:
+            self._counter_seen = True
+
+    def visit(self, node, ctx):
+        callee = core.dotted_name(node.func)
+        if callee is None:
+            return
+        parts = callee.split(".")
+        if not (len(parts) == 2 and parts[0] == "chaos" and parts[1] in CHAOS_FUNCS):
+            return
+        if _is_chaos_module(ctx.relpath):
+            return  # the implementation's own internals
+        if not node.args:
+            return
+        site_arg = node.args[0]
+        if not (isinstance(site_arg, ast.Constant) and isinstance(site_arg.value, str)):
+            ctx.report(
+                self,
+                node,
+                "chaos.{}() called with a non-literal site id — sites must be "
+                "string literals so plans can target them and the site table "
+                "stays auditable".format(parts[1]),
+            )
+            return
+        self._fired.setdefault(site_arg.value, (ctx.relpath, node.lineno))
+
+    def end_run(self, run):
+        if self._table is None:
+            return  # chaos module not in this scan (fixture runs)
+        anchor_path, anchor_line = self._table_anchor
+        if not self._counter_seen:
+            run.report(
+                self,
+                anchor_path,
+                anchor_line,
+                "chaos module never increments the {!r} obs counter — "
+                "injected faults must be visible in metrics".format(COUNTER_NAME),
+            )
+        for site, (relpath, lineno) in sorted(self._fired.items()):
+            if site not in self._table:
+                run.report(
+                    self,
+                    relpath,
+                    lineno,
+                    "chaos site {!r} is fired here but missing from the site "
+                    "table in chaos/__init__.py — add a ``{}``  row so plan "
+                    "authors can find it".format(site, site),
+                )
+        for site in sorted(set(self._table) - set(self._fired)):
+            run.report(
+                self,
+                anchor_path,
+                anchor_line,
+                "chaos site {!r} is documented in the site table but never "
+                "fired anywhere in the scanned code — stale row or missing "
+                "injection point".format(site),
+            )

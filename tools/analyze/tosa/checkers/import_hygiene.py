@@ -1,0 +1,100 @@
+"""import-hygiene: importing the library must be free of side effects.
+
+``import tensorflowonspark_tpu`` happens inside Spark executors, pytest
+collection, doc generation and user notebooks — long before any cluster
+exists. Module import must therefore never:
+
+* call ``logging.basicConfig`` — it hijacks the embedding application's
+  root logger config (``util.setup_logging`` is the sanctioned, explicit
+  entry point);
+* touch the JAX runtime (``jax.devices()``, ``jax.distributed.
+  initialize()``, device counts, process indices) — these initialize the
+  backend with whatever happens to be visible at import time, breaking
+  ``JAX_PLATFORMS`` overrides and multi-process setup ordering;
+* construct Spark entry points (``SparkContext(...)``,
+  ``SparkSession.builder...getOrCreate()``) — the driver owns the session.
+
+"Module level" means any code that executes on import: plain module
+statements AND class bodies. Function/lambda bodies are exempt — they run
+only when called. The rule applies to library code (``tensorflowonspark_
+tpu/``); scripts and benchmarks own their process and may configure it.
+"""
+
+import ast
+
+from .. import core
+
+#: jax.* attribute calls that initialize or query the runtime backend
+JAX_RUNTIME_CALLS = {
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.process_index", "jax.process_count",
+    "jax.distributed.initialize",
+}
+LIBRARY_PREFIX = "tensorflowonspark_tpu/"
+
+
+class ImportHygieneChecker(core.Checker):
+    rule = "import-hygiene"
+    description = (
+        "no logging.basicConfig, JAX runtime init, or Spark session "
+        "construction at library import time"
+    )
+    interests = (ast.Call,)
+
+    def visit(self, node, ctx):
+        if ctx.in_function():
+            return  # lazy scope: runs when called, not on import
+        if not ctx.relpath.replace("\\", "/").startswith(LIBRARY_PREFIX):
+            return
+        callee = core.dotted_name(node.func) or ""
+        if callee.endswith("logging.basicConfig") or callee == "basicConfig":
+            ctx.report(
+                self,
+                node,
+                "logging.basicConfig at import time hijacks the embedding "
+                "application's root logger — use util.setup_logging() from "
+                "an entry point instead",
+            )
+            return
+        if callee in JAX_RUNTIME_CALLS:
+            ctx.report(
+                self,
+                node,
+                "{}() at import time initializes the JAX backend before "
+                "JAX_PLATFORMS / distributed setup can run — defer to first "
+                "use inside a function".format(callee),
+            )
+            return
+        if callee == "SparkContext" or callee.endswith(".SparkContext"):
+            ctx.report(
+                self,
+                node,
+                "SparkContext constructed at import time — the driver owns "
+                "the Spark entry point; accept sc/session as a parameter",
+            )
+            return
+        if self._is_builder_get_or_create(node.func):
+            ctx.report(
+                self,
+                node,
+                "SparkSession.builder...getOrCreate() at import time creates "
+                "a session as a side effect of import — the driver owns the "
+                "Spark entry point",
+            )
+
+    @staticmethod
+    def _is_builder_get_or_create(func):
+        """Matches ``X.builder[.config(...)...].getOrCreate`` — the chain may
+        contain intermediate calls, which defeats plain dotted_name."""
+        if not (isinstance(func, ast.Attribute) and func.attr == "getOrCreate"):
+            return False
+        node = func.value
+        while True:
+            if isinstance(node, ast.Call):
+                node = node.func
+            elif isinstance(node, ast.Attribute):
+                if node.attr == "builder":
+                    return True
+                node = node.value
+            else:
+                return False

@@ -1,0 +1,89 @@
+"""jit-host-sync: no device->host synchronization inside traced functions.
+
+A ``.item()`` / ``float()`` / ``np.asarray()`` / ``.block_until_ready()``
+on a traced value either fails at trace time (ConcretizationTypeError) or —
+worse, when it sneaks through on a concrete leaf — inserts a blocking
+device round-trip into every step of a compiled program, serializing the
+dispatch pipeline. Deliberate syncs belong OUTSIDE the jitted step (the
+``TimeHistory.batch_end`` fencing pattern in ``train/metrics.py``) or on
+the checker's allowlist / an inline suppression with a reason.
+"""
+
+import ast
+
+from .. import core
+from . import _jitscan
+
+#: attribute calls that force a host sync on an array
+SYNC_METHODS = {"item", "tolist", "block_until_ready", "numpy"}
+#: dotted callees that materialize a host value from a device array
+SYNC_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array",
+}
+#: builtins that concretize a traced scalar
+SYNC_BUILTINS = {"float", "int", "bool"}
+#: ``"relpath:function_name"`` entries exempted as deliberate syncs
+ALLOWLIST = frozenset()
+
+
+class JitHostSyncChecker(core.Checker):
+    rule = "jit-host-sync"
+    description = (
+        "no .item()/float()/np.asarray()/.block_until_ready() on device "
+        "values inside functions traced by jax.jit/pjit/shard_map"
+    )
+    interests = ()  # findings are computed per-file from the traced set
+
+    def __init__(self, allowlist=ALLOWLIST):
+        self.allowlist = allowlist
+
+    def end_file(self, ctx):
+        for fn, reason in _jitscan.traced_functions(ctx.tree):
+            name = getattr(fn, "name", "<lambda>")
+            if "{}:{}".format(ctx.relpath, name) in self.allowlist:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                self._check_call(node, name, reason, ctx)
+
+    def _check_call(self, call, fn_name, reason, ctx):
+        callee = core.dotted_name(call.func)
+        if isinstance(call.func, ast.Attribute) and call.func.attr in SYNC_METHODS:
+            ctx.report(
+                self,
+                call,
+                "host sync .{}() inside traced function {!r} ({}) — compute it "
+                "outside the jitted step".format(call.func.attr, fn_name, reason),
+            )
+            return
+        if callee in SYNC_CALLS:
+            ctx.report(
+                self,
+                call,
+                "{}() inside traced function {!r} ({}) materializes a host "
+                "array mid-trace — use jnp, or move it out of the step".format(
+                    callee, fn_name, reason
+                ),
+            )
+            return
+        if (
+            callee in SYNC_BUILTINS
+            and len(call.args) == 1
+            and not isinstance(call.args[0], ast.Constant)
+        ):
+            ctx.report(
+                self,
+                call,
+                "{}() on a (potentially traced) value inside traced function "
+                "{!r} ({}) forces a device sync — keep scalars as 0-d arrays "
+                "until after the step".format(callee, fn_name, reason),
+            )
+        if callee is not None and callee.rsplit(".", 1)[-1] == "device_get":
+            ctx.report(
+                self,
+                call,
+                "device_get inside traced function {!r} ({}) — transfers "
+                "belong outside the compiled step".format(fn_name, reason),
+            )

@@ -1,0 +1,126 @@
+"""jit-purity: traced functions must be pure.
+
+``jax.jit`` runs the Python body ONCE per input signature to build a jaxpr
+(high-level tracing, Frostig et al. 2018). Any side effect — mutating
+closed-over state, bumping an obs counter, logging, reading the wall
+clock — executes at trace time only, then silently never again: the
+counter undercounts, the log line lies, the timestamp is frozen into the
+compiled program. Effects belong in the host loop around the step.
+"""
+
+import ast
+
+from .. import core
+from . import _jitscan
+
+#: call roots whose invocation is an observable side effect
+EFFECT_ROOTS = {"obs", "logging", "logger", "print", "warnings"}
+#: wall-clock reads frozen at trace time
+CLOCK_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "time.monotonic_ns", "time.sleep", "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+
+
+def _local_bindings(fn):
+    """Names bound within ``fn`` (params, assignments, comprehension and
+    loop targets, withitems, nested defs) — mutations rooted at anything
+    else touch enclosing scope."""
+    names = set()
+    args = fn.args
+    for a in (
+        list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    ):
+        names.add(a.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.add(getattr(node, "name", None))
+    names.discard(None)
+    return names
+
+
+class JitPurityChecker(core.Checker):
+    rule = "jit-purity"
+    description = (
+        "traced functions must not mutate closed-over/self state, call obs "
+        "counters or logging, or read the wall clock"
+    )
+    interests = ()
+
+    def end_file(self, ctx):
+        for fn, reason in _jitscan.traced_functions(ctx.tree):
+            name = getattr(fn, "name", "<lambda>")
+            if isinstance(fn, ast.Lambda):
+                self._check_expr_calls(fn.body, name, reason, ctx)
+                continue
+            local = _local_bindings(fn)
+            for node in ast.walk(fn):
+                self._check_node(node, name, reason, local, ctx)
+
+    def _check_node(self, node, fn_name, reason, local, ctx):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            ctx.report(
+                self,
+                node,
+                "{} declaration inside traced function {!r} ({}) — rebinding "
+                "outer state from a jitted body happens at trace time only; "
+                "thread it through the carry instead".format(
+                    "global" if isinstance(node, ast.Global) else "nonlocal",
+                    fn_name, reason,
+                ),
+            )
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if not isinstance(t, (ast.Attribute, ast.Subscript)):
+                    continue
+                root = core.root_name(t)
+                if root is None:
+                    continue
+                if root in ("self", "cls") or root not in local:
+                    ctx.report(
+                        self,
+                        node,
+                        "traced function {!r} ({}) mutates non-local state "
+                        "{!r} — the write runs once at trace time, never in "
+                        "the compiled step; return the new value instead".format(
+                            fn_name, reason, core.dotted_name(t) or root
+                        ),
+                    )
+            return
+        if isinstance(node, ast.Call):
+            self._check_call(node, fn_name, reason, ctx)
+
+    def _check_expr_calls(self, expr, fn_name, reason, ctx):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._check_call(node, fn_name, reason, ctx)
+
+    def _check_call(self, call, fn_name, reason, ctx):
+        callee = core.dotted_name(call.func)
+        if callee is None:
+            return
+        root = callee.split(".", 1)[0]
+        if root in EFFECT_ROOTS:
+            ctx.report(
+                self,
+                call,
+                "side-effecting call {}() inside traced function {!r} ({}) "
+                "runs at trace time only — count/log in the host loop around "
+                "the step".format(callee, fn_name, reason),
+            )
+        elif callee in CLOCK_CALLS:
+            ctx.report(
+                self,
+                call,
+                "wall-clock read {}() inside traced function {!r} ({}) is "
+                "frozen into the jaxpr at trace time".format(
+                    callee, fn_name, reason
+                ),
+            )

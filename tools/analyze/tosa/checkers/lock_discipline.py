@@ -1,0 +1,276 @@
+"""lock-discipline: a lightweight static race detector for classes.
+
+Compositional, per-class reasoning in the spirit of RacerD (Blackshear et
+al., 2018), scaled to this repo's threading idioms. For every class the
+checker computes:
+
+1. **Thread entry points** — methods (or closures) handed to
+   ``threading.Thread(target=...)``, ``executor.submit(...)`` or
+   ``threading.Timer``, plus everything transitively reachable from them
+   through ``self.method()`` calls.
+2. **Writes** — plain rebinds ``self.attr = ...``, augmented writes
+   ``self.attr += ...`` / ``self.d[k] += ...`` and ``del self.attr``.
+   Pure container stores (``self.d[k] = v``) and mutating method calls on
+   synchronized containers (``queue.Queue``, obs counters) are exempt:
+   single-bytecode dict/set stores are atomic under the GIL and carry no
+   read-modify-write window.
+3. **Lock context** — a write under ``with <expr>:`` where ``<expr>`` names
+   a lock (an attribute assigned ``threading.Lock/RLock/Condition/
+   Semaphore`` anywhere in the class, or any name containing ``lock``/
+   ``cond``/``mutex``) counts as guarded.
+
+An attribute written from two different entry-point groups (two threads,
+or a thread and the "caller" group of ordinary methods) with at least one
+unguarded write is a report — ownership excludes ``__init__``: writes
+before the thread starts happen-before everything the thread does.
+"""
+
+import ast
+
+from .. import core
+
+LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+LOCK_NAME_HINTS = ("lock", "cond", "mutex")
+SYNCHRONIZED_CTORS = {
+    "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue", "Event", "deque",
+    "Barrier",
+} | LOCK_CTORS
+SPAWN_CALLS = {"Thread", "Timer"}
+
+
+def _ctor_suffix(value):
+    name = core.call_name(value)
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+class _Write:
+    __slots__ = ("attr", "method", "locked", "node", "kind")
+
+    def __init__(self, attr, method, locked, node, kind):
+        self.attr = attr
+        self.method = method
+        self.locked = locked
+        self.node = node
+        self.kind = kind
+
+
+class _ClassInfo:
+    def __init__(self, node):
+        self.node = node
+        self.writes = []          # [_Write]
+        self.lock_attrs = set()   # self attrs assigned a lock constructor
+        self.sync_attrs = set()   # self attrs assigned a synchronized type
+        self.spawn_targets = set()  # method/closure qualnames run on threads
+        self.calls = {}           # method -> set of self-methods it calls
+
+
+class LockDisciplineChecker(core.Checker):
+    rule = "lock-discipline"
+    description = (
+        "instance attributes written from more than one thread entry point "
+        "must be written under a lock (or be synchronized types)"
+    )
+    interests = (ast.ClassDef,)
+
+    def visit(self, node, ctx):
+        # only top-of-walk dispatch per class: skip nested classes here,
+        # they are walked as part of their own ClassDef visit anyway
+        info = self._analyze_class(node)
+        for finding in self._race_findings(info):
+            ctx.report(self, finding[0], finding[1])
+
+    # -- per-class analysis --------------------------------------------------
+
+    def _analyze_class(self, cls):
+        info = _ClassInfo(cls)
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_method(item, item.name, info)
+        return info
+
+    def _scan_method(self, fn, qualname, info):
+        info.calls.setdefault(qualname, set())
+        self._scan_body(fn, qualname, info)
+
+    def _scan_body(self, scope, qualname, info):
+        """Walk one function scope; nested defs get their own qualname so a
+        closure handed to Thread(target=...) forms its own entry group."""
+        nested = {}
+        for node in self._walk_scope(scope):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                nested[node.name] = node
+                continue
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Delete)):
+                self._record_write(node, qualname, info, scope)
+            elif isinstance(node, ast.Call):
+                self._record_call(node, qualname, nested, info)
+        for name, sub in nested.items():
+            self._scan_body(sub, "{}.<locals>.{}".format(qualname, name), info)
+
+    @staticmethod
+    def _walk_scope(scope):
+        """Nodes of one function scope, not descending into nested defs
+        (but yielding the defs themselves)."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop(0)
+            yield node
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                stack = list(ast.iter_child_nodes(node)) + stack
+
+    def _record_write(self, node, qualname, info, scope):
+        if isinstance(node, ast.Delete):
+            targets, kind = node.targets, "del"
+        elif isinstance(node, ast.Assign):
+            targets, kind = node.targets, "assign"
+        else:
+            targets, kind = [node.target], "augassign" if isinstance(node, ast.AugAssign) else "assign"
+        for t in targets:
+            attr = self._self_attr(t, kind)
+            if attr is None:
+                continue
+            # classify lock/synchronized attrs from any plain assignment
+            if kind == "assign" and isinstance(t, ast.Attribute) and isinstance(node, ast.Assign):
+                suffix = _ctor_suffix(node.value)
+                if suffix in LOCK_CTORS:
+                    info.lock_attrs.add(attr)
+                    info.sync_attrs.add(attr)
+                    continue
+                if suffix in SYNCHRONIZED_CTORS:
+                    info.sync_attrs.add(attr)
+                    continue
+            locked = self._under_lock(node, scope, info)
+            info.writes.append(_Write(attr, qualname, locked, node, kind))
+
+    @staticmethod
+    def _self_attr(target, kind):
+        """The attribute name for writes we track: ``self.x = / += / del``
+        and ``self.x[k] += ...``; plain container stores ``self.x[k] = v``
+        are exempt (GIL-atomic, no read-modify-write)."""
+        if isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name) \
+                and target.value.id == "self":
+            return target.attr
+        if (
+            kind == "augassign"
+            and isinstance(target, ast.Subscript)
+            and isinstance(target.value, ast.Attribute)
+            and isinstance(target.value.value, ast.Name)
+            and target.value.value.id == "self"
+        ):
+            return target.value.attr
+        return None
+
+    def _under_lock(self, node, scope, info):
+        """Is ``node`` lexically inside a ``with <lock>`` in this scope?
+        Re-walks ancestors cheaply: scopes are small."""
+        for parent in ast.walk(scope):
+            if not isinstance(parent, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(node is d or self._contains(d, node) for d in parent.body):
+                continue
+            for item in parent.items:
+                name = core.dotted_name(item.context_expr) or ""
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    name = core.dotted_name(expr.func) or ""
+                attr = name.split(".")[-1].lower() if name else ""
+                if name.startswith("self.") and name.split(".", 1)[1] in info.lock_attrs:
+                    return True
+                if any(h in attr for h in LOCK_NAME_HINTS):
+                    return True
+        return False
+
+    @staticmethod
+    def _contains(tree, node):
+        return any(n is node for n in ast.walk(tree))
+
+    def _record_call(self, call, qualname, nested, info):
+        callee = core.dotted_name(call.func)
+        edges = info.calls.setdefault(qualname, set())
+        if callee and callee.startswith("self."):
+            parts = callee.split(".")
+            if len(parts) == 2:
+                edges.add(parts[1])
+        elif callee and "." not in callee and callee in nested:
+            edges.add("{}.<locals>.{}".format(qualname, callee))
+        # spawn detection
+        target = None
+        suffix = callee.rsplit(".", 1)[-1] if callee else None
+        if suffix in SPAWN_CALLS:
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+            if target is None and suffix == "Timer" and len(call.args) >= 2:
+                target = call.args[1]
+        elif suffix == "submit" and call.args:
+            target = call.args[0]
+        if target is None:
+            return
+        tname = core.dotted_name(target)
+        if tname and tname.startswith("self.") and tname.count(".") == 1:
+            info.spawn_targets.add(tname.split(".")[1])
+        elif tname and "." not in tname and tname in nested:
+            info.spawn_targets.add("{}.<locals>.{}".format(qualname, tname))
+
+    # -- race computation ----------------------------------------------------
+
+    def _race_findings(self, info):
+        groups = self._entry_groups(info)
+        by_attr = {}
+        for w in info.writes:
+            if w.attr in info.sync_attrs:
+                continue
+            if w.method == "__init__" or w.method.startswith("__init__.<locals>."):
+                continue  # ownership: pre-thread-start writes happen-before
+            group = groups.get(self._base_method(w.method), "main")
+            by_attr.setdefault(w.attr, []).append((group, w))
+        out = []
+        for attr, writes in sorted(by_attr.items()):
+            distinct = {g for g, _ in writes}
+            if len(distinct) < 2:
+                continue
+            unlocked = [(g, w) for g, w in writes if not w.locked]
+            if not unlocked:
+                continue
+            others = lambda g: ", ".join(sorted(distinct - {g})) or "main"
+            for g, w in unlocked:
+                out.append((
+                    w.node,
+                    "self.{} of class {!r} is written in {!r} (entry group "
+                    "{!r}) without a lock, and also written from entry "
+                    "group(s) {} — guard every write with one lock or use a "
+                    "synchronized type".format(
+                        attr, info.node.name, w.method, g, others(g)
+                    ),
+                ))
+        return out
+
+    @staticmethod
+    def _base_method(qualname):
+        return qualname.split(".", 1)[0]
+
+    def _entry_groups(self, info):
+        """method/closure base name -> entry group. A spawned closure
+        ``m.<locals>.f`` makes group ``m.<locals>.f`` but writes recorded
+        under it keep qualnames starting with ``m`` — so group resolution
+        works on full qualnames first, then base methods."""
+        groups = {}
+        # full-qualname groups for spawned closures and their sub-closures
+        closure_targets = {t for t in info.spawn_targets if ".<locals>." in t}
+        method_targets = {t for t in info.spawn_targets if ".<locals>." not in t}
+        # transitive closure over self.method edges for method targets
+        for entry in sorted(method_targets):
+            seen, frontier = set(), [entry]
+            while frontier:
+                m = frontier.pop()
+                if m in seen:
+                    continue
+                seen.add(m)
+                frontier.extend(info.calls.get(m, ()))
+            for m in seen:
+                groups.setdefault(m, "thread:{}".format(entry))
+        # a spawned closure's writes live under qualnames prefixed by it;
+        # map its base method only if the base itself isn't an entry
+        for t in sorted(closure_targets):
+            groups.setdefault(t, "thread:{}".format(t))
+        return groups

@@ -1,0 +1,66 @@
+"""retry-discipline: no bare ``time.sleep`` inside loops.
+
+A ``time.sleep`` in a ``for``/``while`` body is an ad-hoc retry or poll
+loop — exactly the pattern the resilience refactor (PR 2) removed: no
+deadline, no jitter, no give-up accounting, invisible to obs. Pacing
+belongs to the shared vocabulary: ``resilience.Backoff.attempts()`` for
+poll/ticker loops (``for/else`` distinguishes success from timeout),
+``RetryPolicy.call`` for retry bursts, ``Deadline`` for shared budgets.
+``resilience.py`` itself is the one module allowed to sleep — it is where
+the vocabulary is implemented.
+"""
+
+import ast
+
+from .. import core
+
+#: the module that implements the sleeping primitives
+EXEMPT_FILES = ("resilience.py",)
+
+
+class RetryDisciplineChecker(core.Checker):
+    rule = "retry-discipline"
+    description = (
+        "time.sleep inside a for/while loop must go through "
+        "resilience.Backoff/RetryPolicy/Deadline"
+    )
+    interests = (ast.Import, ast.ImportFrom, ast.Call)
+
+    def begin_file(self, ctx):
+        # module aliases of ``time`` (import time as _time) and direct
+        # imports of ``sleep`` (from time import sleep as snooze)
+        ctx.time_aliases = {"time"}
+        ctx.sleep_names = set()
+
+    def visit(self, node, ctx):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    ctx.time_aliases.add(alias.asname or "time")
+            return
+        if isinstance(node, ast.ImportFrom):
+            if node.module == "time":
+                for alias in node.names:
+                    if alias.name == "sleep":
+                        ctx.sleep_names.add(alias.asname or "sleep")
+            return
+        if ctx.relpath.rsplit("/", 1)[-1] in EXEMPT_FILES:
+            return
+        if not self._is_sleep(node, ctx) or ctx.enclosing_loop() is None:
+            return
+        ctx.report(
+            self,
+            node,
+            "bare time.sleep inside a loop — pace polls with "
+            "resilience.Backoff.attempts(deadline=...) (for/else for "
+            "timeouts), retries with resilience.RetryPolicy",
+        )
+
+    @staticmethod
+    def _is_sleep(call, ctx):
+        func = call.func
+        if isinstance(func, ast.Name):
+            return func.id in ctx.sleep_names
+        if isinstance(func, ast.Attribute) and func.attr == "sleep":
+            return isinstance(func.value, ast.Name) and func.value.id in ctx.time_aliases
+        return False

@@ -1,0 +1,318 @@
+"""tosa engine: one parse per file, one walk, checkers as plugins.
+
+The engine parses each target file exactly once, walks the tree exactly
+once with an explicit ancestor stack, and dispatches every node to each
+registered checker (filtered by the checker's declared ``interests``).
+Checkers receive ``begin_file``/``visit``/``end_file`` events plus one
+``end_run`` event for cross-file invariants (chaos site coverage).
+
+Findings flow through three filters before they fail the build:
+
+1. **Inline suppressions** — ``# tosa: disable=<rule>[,<rule>] -- <reason>``
+   on the finding's line silences it (the reason is mandatory by
+   convention and preserved in the JSON report).
+2. **Baseline** — a committed JSON file of grandfathered fingerprints
+   (``rule|path|message``, line-number free so findings don't churn with
+   unrelated edits). Matching findings are reported but don't gate.
+3. Whatever remains is an **unsuppressed finding**: non-zero exit.
+"""
+
+import ast
+import json
+import os
+import re
+
+#: suppression comment: ``# tosa: disable=rule-a,rule-b -- why this is ok``
+_SUPPRESS_RE = re.compile(
+    r"#\s*tosa:\s*disable=([A-Za-z0-9_,-]+)(?:\s*--\s*(?P<reason>.*\S))?"
+)
+
+#: node types that introduce a new runtime scope (bodies do NOT execute at
+#: import time; also the boundary for "lexically inside a loop" queries)
+FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+LOOP_NODES = (ast.For, ast.AsyncFor, ast.While)
+
+
+class Finding:
+    """One rule violation at ``path:line``."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "suppressed", "baselined")
+
+    def __init__(self, rule, path, line, col, message):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.suppressed = None  # the suppression reason, when silenced inline
+        self.baselined = False
+
+    @property
+    def fingerprint(self):
+        """Line-free identity used by the baseline: stable across edits
+        that merely shift code up or down."""
+        return "{}|{}|{}".format(self.rule, self.path, self.message)
+
+    def to_dict(self):
+        d = {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+        if self.suppressed is not None:
+            d["suppressed"] = self.suppressed
+        if self.baselined:
+            d["baselined"] = True
+        return d
+
+    def __repr__(self):
+        return "{}:{}: [{}] {}".format(self.path, self.line, self.rule, self.message)
+
+
+class Checker:
+    """Base class for rule plugins.
+
+    Subclasses set ``rule`` (the id used in reports, ``--rules`` and
+    suppressions) and ``description``, and override any of the event hooks.
+    ``interests`` narrows ``visit`` dispatch to a tuple of node types
+    (``None`` = every node).
+    """
+
+    rule = None
+    description = ""
+    interests = None
+
+    def begin_file(self, ctx):
+        """Called once per file before the walk."""
+
+    def visit(self, node, ctx):
+        """Called for every walked node matching ``interests``."""
+
+    def end_file(self, ctx):
+        """Called once per file after the walk."""
+
+    def end_run(self, run):
+        """Called once after every file; cross-file findings go through
+        ``run.report(...)``."""
+
+
+class FileContext:
+    """Per-file state handed to checkers: source, tree, ancestor stack."""
+
+    def __init__(self, path, relpath, source, tree):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = tree
+        self.stack = []  # ancestors of the node currently being visited
+        self.findings = []
+
+    def report(self, checker, node, message):
+        self.findings.append(
+            Finding(
+                checker.rule,
+                self.relpath,
+                getattr(node, "lineno", 1),
+                getattr(node, "col_offset", 0),
+                message,
+            )
+        )
+
+    # -- stack queries shared by checkers -----------------------------------
+
+    def in_function(self):
+        """True when the current node's body executes lazily (any enclosing
+        def/lambda), i.e. NOT at import time. Class bodies execute on
+        import, so they don't count."""
+        return any(isinstance(a, FUNCTION_NODES) for a in self.stack)
+
+    def enclosing_loop(self):
+        """The nearest For/While ancestor within the current function —
+        loop ancestry does not cross a def/lambda boundary (a function
+        defined inside a loop runs where it is called)."""
+        for a in reversed(self.stack):
+            if isinstance(a, LOOP_NODES):
+                return a
+            if isinstance(a, FUNCTION_NODES):
+                return None
+        return None
+
+
+class RunContext:
+    """Cross-file accumulator passed to ``end_run``."""
+
+    def __init__(self):
+        self.findings = []
+
+    def report(self, checker, relpath, line, message):
+        self.findings.append(Finding(checker.rule, relpath, line, 0, message))
+
+
+def dotted_name(node):
+    """``a.b.c`` for a Name/Attribute chain, else None (calls, subscripts
+    and other dynamic roots are not resolvable statically)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call):
+    """Dotted name of a Call's callee, or None."""
+    return dotted_name(call.func) if isinstance(call, ast.Call) else None
+
+
+def root_name(node):
+    """The base Name of an arbitrarily nested Attribute/Subscript chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _suppressions(source):
+    """Map line number -> (set of silenced rule ids, reason)."""
+    out = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out[i] = (rules, m.group("reason") or "")
+    return out
+
+
+def _walk(tree, checkers, ctx):
+    """Single depth-first walk with an explicit ancestor stack."""
+
+    def visit(node):
+        for checker in checkers:
+            if checker.interests is None or isinstance(node, checker.interests):
+                checker.visit(node, ctx)
+        ctx.stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+        ctx.stack.pop()
+
+    visit(tree)
+
+
+def iter_python_files(targets):
+    """Expand files/directories into a sorted list of ``*.py`` paths."""
+    out = []
+    for target in targets:
+        if os.path.isdir(target):
+            for dirpath, dirnames, filenames in os.walk(target):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in ("__pycache__", ".git")
+                )
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(dirpath, name))
+        elif target.endswith(".py"):
+            out.append(target)
+    return out
+
+
+def analyze_files(paths, checkers, root=None):
+    """Run ``checkers`` over ``paths`` (one parse + one walk per file).
+    Returns the full finding list — suppressed entries annotated, nothing
+    dropped (the CLI layer decides what gates)."""
+    root = root or os.getcwd()
+    findings = []
+    run = RunContext()
+    per_file_suppressions = {}
+    for path in paths:
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            f_err = Finding("parse-error", relpath, 1, 0, "unreadable: {}".format(e))
+            findings.append(f_err)
+            continue
+        per_file_suppressions[relpath] = _suppressions(source)
+        findings.extend(analyze_source(source, relpath, checkers, run=run, path=path))
+    for checker in checkers:
+        checker.end_run(run)
+    for f in run.findings:  # cross-file findings honor their anchor file's
+        _apply_suppressions([f], per_file_suppressions.get(f.path, {}))
+    findings.extend(run.findings)
+    return findings
+
+
+def analyze_source(source, relpath, checkers, run=None, path=None):
+    """Analyze one already-read source blob; the test-fixture entry point."""
+    if run is None:
+        run = RunContext()
+        finish = True
+    else:
+        finish = False
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as e:
+        return [Finding("parse-error", relpath, e.lineno or 1, 0, "unparseable: {}".format(e.msg))]
+    ctx = FileContext(path or relpath, relpath, source, tree)
+    for checker in checkers:
+        checker.begin_file(ctx)
+    _walk(tree, checkers, ctx)
+    for checker in checkers:
+        checker.end_file(ctx)
+    findings = _apply_suppressions(ctx.findings, _suppressions(source))
+    if finish:
+        for checker in checkers:
+            checker.end_run(run)
+        findings.extend(_apply_suppressions(run.findings, _suppressions(source)))
+    return findings
+
+
+def _apply_suppressions(findings, suppressions):
+    for f in findings:
+        entry = suppressions.get(f.line)
+        if entry and (f.rule in entry[0] or "all" in entry[0]):
+            f.suppressed = entry[1] or "(no reason given)"
+    return findings
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path):
+    """Baseline fingerprints -> remaining allowance count."""
+    if not path or not os.path.isfile(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    counts = {}
+    for fp in data.get("findings", []):
+        counts[fp] = counts.get(fp, 0) + 1
+    return counts
+
+
+def apply_baseline(findings, baseline):
+    """Mark findings covered by the baseline (each entry grandfathers one
+    occurrence of its fingerprint)."""
+    remaining = dict(baseline)
+    for f in findings:
+        if f.suppressed is not None:
+            continue
+        if remaining.get(f.fingerprint, 0) > 0:
+            remaining[f.fingerprint] -= 1
+            f.baselined = True
+    return findings
+
+
+def write_baseline(path, findings):
+    """Grandfather every currently-unsuppressed finding."""
+    fps = sorted(f.fingerprint for f in findings if f.suppressed is None)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"findings": fps}, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def gating(findings):
+    """The findings that fail the build: neither suppressed nor baselined."""
+    return [f for f in findings if f.suppressed is None and not f.baselined]
