@@ -50,27 +50,35 @@ REFERENCE_IMG_PER_SEC_PER_CHIP = 2000.0
 
 
 #: a train block cannot beat its own input path: both consume the same
-#: prefetch generator, so ratios above ~1.0 mean the link/host mood shifted
-#: between the two blocks of a pair. Beyond this tolerance the pair is
-#: measurement noise, not signal — it is flagged and excluded from the
-#: median (BENCH_r05 folded a physically impossible 3.30 into its headline).
+#: prefetch generator, so a ratio far from ~1.0 in EITHER direction means
+#: the link/host mood shifted between the two blocks of a pair. Outside the
+#: symmetric band [1/1.10, 1.10] the pair is measurement noise, not signal —
+#: it is flagged and excluded from the median (BENCH_r05 folded a physically
+#: impossible 3.30 into its headline, and kept a 0.881 that is the same
+#: mood-shift artifact mirrored).
 MAX_VALID_PAIR_RATIO = 1.10
 
 
-def partition_pairs(nc_rates, tr_rates, max_ratio=MAX_VALID_PAIR_RATIO):
+def partition_pairs(nc_rates, tr_rates, max_ratio=MAX_VALID_PAIR_RATIO, min_ratio=None):
     """Split recorded (no-compute, train) rate pairs into valid and invalid
-    by their train/input-path ratio. Returns ``(valid, invalid)`` as lists
-    of ``(nc, tr)`` tuples, preserving pair order."""
+    by their train/input-path ratio: valid iff ``min_ratio <= tr/nc <=
+    max_ratio`` (``min_ratio`` defaults to ``1/max_ratio`` — the band is
+    symmetric, since a mood shift is equally likely in either half of a
+    pair). Returns ``(valid, invalid)`` as lists of ``(nc, tr)`` tuples,
+    preserving pair order."""
+    if min_ratio is None:
+        min_ratio = 1.0 / max_ratio
     valid, invalid = [], []
     for nc, tr in zip(nc_rates, tr_rates):
-        (valid if tr / nc <= max_ratio else invalid).append((nc, tr))
+        (valid if min_ratio <= tr / nc <= max_ratio else invalid).append((nc, tr))
     return valid, invalid
 
 
 def confidence_fields(pairs_recorded, pairs_requested, invalid_pairs=0):
     """Annotation for pair-budgeted results: how many train/no-compute pairs
     actually landed out of how many were requested, how many were discarded
-    as invalid (ratio > :data:`MAX_VALID_PAIR_RATIO`), and
+    as invalid (ratio outside the symmetric :data:`MAX_VALID_PAIR_RATIO`
+    band), and
     ``low_confidence: true`` when the median rests on fewer usable samples
     than the operator asked for (budget cut the run short, or pairs were
     discarded)."""
@@ -83,6 +91,51 @@ def confidence_fields(pairs_recorded, pairs_requested, invalid_pairs=0):
     if pairs_recorded - invalid_pairs < pairs_requested:
         fields["low_confidence"] = True
     return fields
+
+
+def seed_autotuner(tuner, per_batch_rate, packed_rate, win, batch_imgs, batch_bytes):
+    """Seed ``tuner``'s link model from the transfer-shape A/B probes the
+    bench already runs (no extra transfers): the per-batch leg times
+    ``fixed + bytes/bw`` per batch, the packed leg ``fixed + K·bytes/bw``
+    per window — two equations, two unknowns. Returns True when the seed
+    landed (both probes ran and the solution is physical)."""
+    if per_batch_rate <= 0 or packed_rate <= 0 or win <= 1:
+        return False
+    pb_t = batch_imgs / per_batch_rate       # seconds per per-batch transfer
+    win_t = win * batch_imgs / packed_rate   # seconds per packed window
+    fixed = max(0.0, (win * pb_t - win_t) / (win - 1))
+    stream = max(pb_t - fixed, 1e-6)
+    tuner.note_fixed_probe(fixed)
+    tuner.note_transfer(batch_bytes, fixed + stream)
+    return True
+
+
+def feed_fields(tuner, window_k, batch_bytes):
+    """The BENCH JSON ``feed`` block: the window size actually used, the
+    autotuner's recommendation and link estimate (the measurement the run
+    tuned against), and the producer/consumer stall counters — so a
+    recorded trajectory explains itself instead of sampling the relay's
+    mood."""
+    from tensorflowonspark_tpu import obs
+
+    counters = obs.snapshot()["counters"]
+
+    def _c(name):
+        return round(counters.get(name, {}).get("value", 0.0), 3)
+
+    out = {"window_k": int(window_k)}
+    est = tuner.estimator
+    if est.ready:
+        out["autotuned_k"] = int(tuner.recommend(batch_bytes))
+        out["link_bytes_per_sec"] = round(est.bytes_per_sec, 1)
+        out["link_fixed_cost_seconds"] = round(est.fixed_s, 4)
+    out["stalls"] = {
+        "producer_read_seconds": _c("data_producer_read_seconds_total"),
+        "producer_parse_seconds": _c("data_producer_parse_seconds_total"),
+        "producer_emit_seconds": _c("data_producer_emit_seconds_total"),
+        "consumer_wait_seconds": _c("data_consumer_wait_seconds_total"),
+    }
+    return out
 
 
 def _force_platform_for_tiny(tiny):
@@ -233,6 +286,13 @@ def bench_resnet(tiny, real_data):
             sum(shape_rates["packed"]) / len(shape_rates["packed"])
             if shape_rates["packed"] else 0.0
         )
+        from tensorflowonspark_tpu.data import FeedAutotuner
+
+        # seed the adaptive-feed link model from the same probes (uint8
+        # images dominate; the label leaf is noise next to H*W*3 bytes)
+        feed_batch_bytes = batch * (image_size * image_size * 3 + 8)
+        feed_tuner = FeedAutotuner()
+        seed_autotuner(feed_tuner, mean_pb, mean_pk, win, batch, feed_batch_bytes)
         if mode_env == "auto":
             # tie-bias toward packed: at equal bandwidth one big transfer
             # strictly wins (K fewer fixed costs), so per-batch must beat it
@@ -382,8 +442,8 @@ def bench_resnet(tiny, real_data):
                     [round(v / n_chips, 1) for v in nc_rates],
                     [round(r, 3) for r in ratios],
                     "packed" if packed else "per-batch",
-                    " | {} invalid pair(s) discarded (ratio > {})".format(
-                        len(invalid), MAX_VALID_PAIR_RATIO
+                    " | {} invalid pair(s) discarded (ratio outside [{:.3f}, {}])".format(
+                        len(invalid), 1.0 / MAX_VALID_PAIR_RATIO, MAX_VALID_PAIR_RATIO
                     ) if invalid else "",
                 ),
                 file=sys.stderr,
@@ -447,6 +507,10 @@ def bench_resnet(tiny, real_data):
         "vs_baseline": round(vs_baseline, 4),
     }
     result.update(conf)
+    if real_data:
+        result["feed"] = feed_fields(
+            feed_tuner, fused if (fused > 1 and packed) else 1, feed_batch_bytes
+        )
     return result
 
 

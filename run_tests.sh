@@ -15,6 +15,9 @@
 #   --perf-smoke  run only the perf_smoke marker leg: structural pipelining
 #                 assertions (sleep-staged IO/parse overlap — proves the
 #                 read-ahead actually overlaps, no absolute-throughput flake)
+#                 plus the adaptive-feed leg (sleep-staged data.device_link
+#                 latency: the autotuner must ratchet K up under injected
+#                 latency and bring it back down when the latency clears)
 #   --analyze     print the full tosa static-analysis report as JSON and exit
 #   --native-sanitize  rebuild native/tfrecord_io.cc with ASan+UBSan and run
 #                 the native IO / streaming-chunk tests against it (skips
@@ -84,6 +87,9 @@ else
 fi
 
 if [[ "$PERF_SMOKE" == "1" ]]; then
+  # covers the IO/parse overlap proof AND the autotune adaptation leg
+  # (tests/test_autotune.py::TestChaosDeviceLink) — both sleep-staged,
+  # no real accelerator or absolute-throughput assertion involved
   exec python -m pytest tests/ -q -m perf_smoke ${EXTRA[@]+"${EXTRA[@]}"}
 fi
 

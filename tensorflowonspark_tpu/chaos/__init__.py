@@ -51,6 +51,11 @@ site                            effect at the injection point
 ``data.shard_read``             read-ahead shard open sleeps (``delay_s``) or
                                 raises ``IOError`` (``error: true``); errors
                                 are retried under ``SHARD_READ_RETRY``
+``data.device_link``            autotuned feed sleeps ``delay_s`` inside the
+                                timed region of every host->device transfer
+                                (probes and windows), so injected latency
+                                flows into the link estimate and the window
+                                size K must adapt
 ``checkpoint.corrupt_write``    newest checkpoint left torn on disk
 ``checkpoint.restore_fail``     restore raises ``IOError``
 ``serving.latency``             predictor sleeps before dispatch

@@ -1,0 +1,439 @@
+"""Adaptive device-feed autotuner: online link probing + dynamic packed windows.
+
+docs/perf.md establishes that on relayed/tunneled TPU runtimes the
+host→device link — not the MXU and not the host pipeline — sets the training
+ceiling: **~250 ms fixed cost per transfer plus a 6–30 MB/s stream that
+swings 3× within minutes**. The packed-window size ``K`` that amortizes that
+fixed cost (``compile_train_loop(packed=True)`` +
+:func:`~tensorflowonspark_tpu.data.packed_prefetch`) was a constant chosen
+offline; this module chooses it *online*, the way tf.data's AUTOTUNE and
+Plumber tune input pipelines by measurement instead of configuration —
+exactly the right trade when the bottleneck resource shifts at runtime,
+which is this link's defining pathology.
+
+The pieces:
+
+* :class:`LinkEstimator` — the two-parameter cost model
+  ``T(bytes) = fixed + bytes / bytes_per_sec``. The *fixed* term is
+  estimated from timed, fenced micro-probes (a few bytes: stream time is
+  negligible, so the probe time IS the fixed cost); the *stream* term from
+  timed, fenced production window transfers (observed time minus the
+  current fixed estimate). Both update through an EWMA, seeded one-shot by
+  the first observation of each kind.
+* :class:`FeedAutotuner` — the controller: owns the estimator, a bounded
+  bucket set of window sizes (powers of two, default ``{1, 2, 4, 8, 16}``
+  so the per-K compiled-loop cache stays small), and the decision rule:
+  the smallest bucket whose predicted fixed-cost share
+  ``fixed / T(K · batch_bytes)`` is at or below ``overhead_target``.
+  Upward moves apply immediately (a latency spike is expensive *now*);
+  downward moves wait for ``down_patience`` consecutive recommendations
+  (hysteresis against mood flicker, and each downward bucket move risks a
+  recompile). Prefetch depth comes along for free: small windows pipeline
+  ``depth=2`` ahead, large windows (≥ ``deep_window_k``) hold device
+  memory to the double buffer (current + one in flight).
+* :func:`autotuned_prefetch` — the drop-in sibling of
+  :func:`~tensorflowonspark_tpu.data.loop_prefetch` /
+  :func:`~tensorflowonspark_tpu.data.packed_prefetch`: groups host batches
+  into device-resident ``[K, B, ...]`` stacks where ``K`` follows the
+  controller, windows double-buffered ``depth`` ahead. The delivered batch
+  stream is **byte-identical regardless of K** (batches are grouped in
+  arrival order and the source tail is flushed by binary decomposition
+  into bucket-sized windows, so nothing is dropped and every window size
+  is a bucket).
+* :class:`~tensorflowonspark_tpu.train.strategy.PackedLoopCache` (train
+  layer) — compiles the packed train loop at most once per bucket and
+  counts ``feed_recompiles_total``.
+
+Donation safety: windows are retained by the prefetch buffer for
+double-buffering, so the packed train loop must NOT donate them — the
+``[K,B,H,W,C]`` uint8 input stack aliases no output anyway, and donating it
+bought nothing but XLA's "donated buffers were not usable" warning
+(BENCH_r05). ``compile_train_loop(packed=True)`` therefore donates only the
+train state, and :class:`PackedLoopCache` compiles with that contract.
+
+Every decision is exported through :mod:`~tensorflowonspark_tpu.obs` and
+surfaces in ``TFCluster.metrics()``:
+
+==================================  =======================================
+metric                              meaning
+==================================  =======================================
+``feed_link_bytes_per_sec``         current stream-bandwidth estimate
+``feed_transfer_fixed_cost_seconds``current per-transfer fixed-cost estimate
+``feed_window_size``                the K the controller currently feeds
+``feed_recompiles_total``           packed-loop compilations (≤ one/bucket)
+``feed_transfer_seconds_total``     fenced wall time spent in transfers
+==================================  =======================================
+
+The ``data.device_link`` chaos site injects a per-transfer delay inside the
+timed region (probes and production windows alike), which makes adaptation
+deterministically testable: raise the injected latency mid-run and the
+controller must move K up; drop it and K must come back down
+(tests/test_autotune.py, and the ``--perf-smoke`` leg of run_tests.sh).
+"""
+
+import collections
+import logging
+import time
+
+from tensorflowonspark_tpu import chaos, obs
+
+logger = logging.getLogger(__name__)
+
+#: default bounded bucket set for the packed-window size K: powers of two,
+#: so the per-K compiled-loop cache holds at most 5 programs and any source
+#: tail decomposes exactly into bucket-sized windows (binary representation)
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16)
+
+#: resolvability threshold for the stream term: an observed transfer whose
+#: time beyond the fixed-cost estimate is below this says nothing about
+#: bandwidth (dividing by ~0 would poison the model with a near-infinite
+#: estimate that takes many windows to forget), so such samples only feed
+#: the fixed-cost clamp
+MIN_STREAM_SECONDS = 1e-6
+
+
+class LinkEstimator:
+    """EWMA estimate of the link cost model ``T(bytes) = fixed + bytes/bw``.
+
+    ``alpha`` is the EWMA weight of the newest observation (0.3 default:
+    responsive within a handful of windows, yet one freak sample cannot
+    swing a bucket decision by itself). The first observation of each kind
+    seeds its parameter directly — the one-shot probe contract.
+    """
+
+    def __init__(self, alpha=0.3):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError("alpha must be in (0, 1]")
+        self.alpha = alpha
+        self.fixed_s = None
+        self.bytes_per_sec = None
+
+    @property
+    def ready(self):
+        """True once both model parameters have at least one observation."""
+        return self.fixed_s is not None and self.bytes_per_sec is not None
+
+    def _ewma(self, old, new):
+        return new if old is None else (1.0 - self.alpha) * old + self.alpha * new
+
+    def observe_fixed(self, seconds):
+        """Feed one timed micro-probe (payload small enough that stream time
+        is negligible): the sample IS the per-transfer fixed cost."""
+        self.fixed_s = self._ewma(self.fixed_s, max(0.0, seconds))
+
+    def observe(self, nbytes, seconds):
+        """Feed one timed, fenced production transfer of ``nbytes``.
+
+        The stream share is ``seconds`` minus the current fixed estimate; a
+        transfer that beats the fixed estimate also drags ``fixed_s`` down
+        (the link cannot have a fixed cost larger than a whole observed
+        transfer), so the model recovers even if the probe caught a spike.
+        A transfer that fits entirely inside the fixed estimate resolves no
+        stream share at all and leaves the bandwidth estimate untouched.
+        """
+        if nbytes <= 0 or seconds <= 0:
+            return
+        if self.fixed_s is None:
+            self.fixed_s = 0.0
+        if seconds < self.fixed_s:
+            self.fixed_s = self._ewma(self.fixed_s, seconds)
+        stream = seconds - self.fixed_s
+        if stream < MIN_STREAM_SECONDS:
+            return
+        self.bytes_per_sec = self._ewma(self.bytes_per_sec, nbytes / stream)
+
+    def predict(self, nbytes):
+        """Predicted transfer seconds for ``nbytes`` under the current model
+        (None until :attr:`ready`)."""
+        if not self.ready:
+            return None
+        return self.fixed_s + nbytes / max(self.bytes_per_sec, 1e-9)
+
+    def fixed_share(self, nbytes):
+        """Fraction of a predicted ``nbytes`` transfer spent on the fixed
+        cost — the quantity the window size K exists to amortize."""
+        total = self.predict(nbytes)
+        if not total:
+            return 0.0
+        return self.fixed_s / total
+
+
+class AutotunedWindow:
+    """One device-resident packed window: ``data`` is the ``[k, B, ...]``
+    pytree (placed via :func:`~tensorflowonspark_tpu.data.packed_place`),
+    ``k`` the bucket it was built for — feed it to
+    :meth:`PackedLoopCache.run <tensorflowonspark_tpu.train.strategy.PackedLoopCache.run>`."""
+
+    __slots__ = ("data", "k")
+
+    def __init__(self, data, k):
+        self.data = data
+        self.k = k
+
+
+class FeedAutotuner:
+    """Online controller for the packed-window size K and prefetch depth.
+
+    Decision rule: the smallest bucket whose predicted fixed-cost share
+    ``fixed / (fixed + K·batch_bytes/bw)`` is ≤ ``overhead_target``
+    (default 0.1 — at the measured ~250 ms fixed cost and ~20 MB/s this
+    lands on K=8, the value BENCH_FUSED converged to by hand). Upward
+    moves apply immediately; downward moves need ``down_patience``
+    consecutive lower recommendations. Every ``reprobe_every``-th window a
+    fenced micro-probe refreshes the fixed-cost estimate, so a mood change
+    is seen even while the window size (and thus the bytes term) is
+    steady.
+
+    ``clock`` is injectable for deterministic tests (defaults to
+    ``time.perf_counter``); the estimator itself is pure arithmetic and can
+    be driven directly through :meth:`note_fixed_probe` /
+    :meth:`note_transfer`.
+    """
+
+    def __init__(
+        self,
+        buckets=DEFAULT_BUCKETS,
+        overhead_target=0.1,
+        down_patience=2,
+        reprobe_every=4,
+        deep_window_k=8,
+        alpha=0.3,
+        clock=None,
+    ):
+        if not buckets:
+            raise ValueError("buckets must be non-empty")
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if any(b < 1 for b in self.buckets):
+            raise ValueError("buckets must be >= 1")
+        if not 0.0 < overhead_target < 1.0:
+            raise ValueError("overhead_target must be in (0, 1)")
+        self.overhead_target = overhead_target
+        self.down_patience = max(1, int(down_patience))
+        self.reprobe_every = max(0, int(reprobe_every))
+        self.deep_window_k = int(deep_window_k)
+        self.estimator = LinkEstimator(alpha=alpha)
+        self._clock = clock or time.perf_counter
+        self._k = None
+        self._down_streak = 0
+        self._windows_placed = 0
+        # instruments created eagerly so the five feed_* metrics exist in
+        # every snapshot that saw a tuner, even before the first transfer
+        self._bw_g = obs.gauge(
+            "feed_link_bytes_per_sec",
+            help="autotuner estimate of the host->device stream bandwidth",
+        )
+        self._fixed_g = obs.gauge(
+            "feed_transfer_fixed_cost_seconds",
+            help="autotuner estimate of the per-transfer fixed cost",
+        )
+        self._k_g = obs.gauge(
+            "feed_window_size", help="packed-window size K currently fed"
+        )
+        obs.counter(
+            "feed_recompiles_total",
+            help="packed train-loop compilations (bounded by the bucket set)",
+        )
+        self._transfer_c = obs.counter(
+            "feed_transfer_seconds_total",
+            help="fenced wall seconds spent in host->device window transfers",
+        )
+
+    # -- estimator feeding (pure; used by the timed paths below) ---------------
+
+    def note_fixed_probe(self, seconds):
+        """Record one fixed-cost probe sample and publish the estimate."""
+        self.estimator.observe_fixed(seconds)
+        self._fixed_g.set(self.estimator.fixed_s)
+
+    def note_transfer(self, nbytes, seconds):
+        """Record one production window transfer and publish the estimates."""
+        self.estimator.observe(nbytes, seconds)
+        self._transfer_c.inc(seconds)
+        if self.estimator.bytes_per_sec is not None:
+            self._bw_g.set(self.estimator.bytes_per_sec)
+        if self.estimator.fixed_s is not None:
+            self._fixed_g.set(self.estimator.fixed_s)
+
+    # -- the decision -----------------------------------------------------------
+
+    def recommend(self, batch_bytes):
+        """The bucket the model currently argues for (no hysteresis)."""
+        if not self.estimator.ready or batch_bytes <= 0:
+            return self.buckets[0]
+        for k in self.buckets:
+            if self.estimator.fixed_share(k * batch_bytes) <= self.overhead_target:
+                return k
+        return self.buckets[-1]
+
+    def decide(self, batch_bytes):
+        """Select ``(k, depth)`` for the NEXT window and publish the choice.
+
+        The first call jumps straight to the recommendation (the one-shot
+        probe seeded the model; there is no history to be cautious about);
+        after that, K moves at most one bucket per call — up immediately,
+        down only after ``down_patience`` consecutive lower
+        recommendations.
+        """
+        rec = self.recommend(batch_bytes)
+        if self._k is None:
+            self._k = rec
+        elif rec > self._k:
+            self._k = self.buckets[self.buckets.index(self._k) + 1]
+            self._down_streak = 0
+        elif rec < self._k:
+            self._down_streak += 1
+            if self._down_streak >= self.down_patience:
+                self._k = self.buckets[self.buckets.index(self._k) - 1]
+                self._down_streak = 0
+        else:
+            self._down_streak = 0
+        self._k_g.set(self._k)
+        return self._k, self.depth(self._k)
+
+    def depth(self, k):
+        """Windows kept in flight beyond the one handed out: 2 for small
+        windows (cheap, deep pipeline), 1 from ``deep_window_k`` up (the
+        double buffer — current window training, one window in transfer —
+        bounds device memory at ~2 windows like the static packed path)."""
+        return 1 if k >= self.deep_window_k else 2
+
+    # -- timed, fenced placement ------------------------------------------------
+
+    @staticmethod
+    def _fence(tree):
+        """One-element readback proving the transfer landed (slicing on
+        device first, so the fence never ships the array back — the same
+        fencing bench.py uses; ``block_until_ready`` can return at the
+        relay ack)."""
+        import jax
+        import numpy as np
+
+        leaf = jax.tree.leaves(tree)[0]
+        _ = np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
+
+    def _fire_link_chaos(self):
+        if chaos.active:
+            spec = chaos.fire("data.device_link")
+            if spec is not None:
+                time.sleep(spec.get("delay_s", 0.05))
+
+    def probe_fixed(self, strategy):
+        """One fenced micro-transfer (8 bytes: pure fixed cost) through the
+        same device path as production windows; feeds the fixed estimate."""
+        import jax
+        import numpy as np
+
+        del strategy  # placement target is any addressable device
+        payload = np.zeros(8, np.uint8)
+        t0 = self._clock()
+        self._fire_link_chaos()
+        self._fence(jax.device_put(payload))
+        self.note_fixed_probe(self._clock() - t0)
+
+    def place(self, window, strategy):
+        """Stack ``window`` (list of host batch pytrees) into one device
+        transfer via :func:`~tensorflowonspark_tpu.data.packed_place`,
+        timed and fenced, feeding the estimator. Returns an
+        :class:`AutotunedWindow`."""
+        import jax
+
+        from tensorflowonspark_tpu.data.loader import packed_place
+
+        if self.reprobe_every and self._windows_placed % self.reprobe_every == 0:
+            self.probe_fixed(strategy)
+        self._windows_placed += 1
+        nbytes = sum(
+            leaf.nbytes for batch in window for leaf in jax.tree.leaves(batch)
+        )
+        t0 = self._clock()
+        self._fire_link_chaos()
+        placed = packed_place(window, strategy)
+        self._fence(placed)
+        self.note_transfer(nbytes, self._clock() - t0)
+        return AutotunedWindow(placed, len(window))
+
+
+def batch_nbytes(batch):
+    """Host-side bytes of one batch pytree (the controller's size unit)."""
+    import jax
+
+    return sum(leaf.nbytes for leaf in jax.tree.leaves(batch))
+
+
+def bucket_decomposition(n, buckets):
+    """Greedy decomposition of ``n`` batches into bucket-sized windows,
+    largest first — with power-of-two buckets down to 1 this is the binary
+    representation of ``n``, so the source tail is delivered exactly and
+    every emitted window size has (or will have) a cached compiled loop.
+    Any residue smaller than the smallest bucket is dropped (impossible
+    when 1 is a bucket)."""
+    sizes = []
+    for b in sorted(buckets, reverse=True):
+        while n >= b:
+            sizes.append(b)
+            n -= b
+    return sizes
+
+
+def autotuned_prefetch(batches, strategy, tuner=None, **tuner_kw):
+    """Group host batches into device-resident packed windows whose size K
+    follows the :class:`FeedAutotuner` — the adaptive sibling of
+    :func:`~tensorflowonspark_tpu.data.packed_prefetch`.
+
+    Yields :class:`AutotunedWindow` objects (``.data`` = ``[k, B, ...]``
+    device pytree, ``.k`` = its bucket); run them with
+    :class:`~tensorflowonspark_tpu.train.strategy.PackedLoopCache`, which
+    compiles the packed loop at most once per bucket::
+
+        tuner = FeedAutotuner()
+        cache = PackedLoopCache(strategy, loss_fn, optimizer, mutable=True)
+        for window in autotuned_prefetch(pipe, strategy, tuner=tuner):
+            state, metrics = cache.run(state, window)
+
+    The delivered batch stream is byte-identical to the K=1 reference for
+    any controller trajectory: batches are grouped strictly in arrival
+    order, and the source tail is flushed through
+    :func:`bucket_decomposition` instead of being dropped. Windows are
+    double-buffered ``tuner.depth(k)`` ahead; the handed-out window stays
+    referenced by the consumer while the next transfers — which is exactly
+    why the packed loop donates only state (see module docstring).
+
+    Extra keyword arguments construct the default tuner
+    (``autotuned_prefetch(pipe, strategy, overhead_target=0.2)``).
+    """
+    if tuner is None:
+        tuner = FeedAutotuner(**tuner_kw)
+    it = iter(batches)
+    buf = collections.deque()
+    pending = []  # host batches drawn but not yet placed
+    exhausted = False
+
+    def _pull():
+        nonlocal exhausted
+        try:
+            pending.append(next(it))
+            return True
+        except StopIteration:
+            exhausted = True
+            return False
+
+    depth = 1
+    while True:
+        while not exhausted and len(buf) <= depth:
+            if not pending and not _pull():
+                break
+            k, depth = tuner.decide(batch_nbytes(pending[0]))
+            while len(pending) < k and _pull():
+                pass
+            if len(pending) < k:
+                break  # tail: flushed below by bucket decomposition
+            buf.append(tuner.place(pending[:k], strategy))
+            del pending[:k]
+        if exhausted and pending:
+            for k in bucket_decomposition(len(pending), tuner.buckets):
+                buf.append(tuner.place(pending[:k], strategy))
+                del pending[:k]
+            pending = []
+        if not buf:
+            return
+        yield buf.popleft()

@@ -34,6 +34,7 @@ Semantics notes:
 """
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
@@ -41,14 +42,16 @@ from flax import linen as nn
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+logger = logging.getLogger(__name__)
+
 #: default row-block; _pick_block shrinks it to divide R exactly
 DEFAULT_BLOCK_R = 512
 
 
-def _pick_block(rows, preferred):
-    """Largest power-of-two block ≤ preferred dividing rows exactly (pallas
-    pads ragged trailing blocks with garbage — same rule as flash attention's
-    ``_pick_block``)."""
+def _pick_block_or_none(rows, preferred):
+    """Largest power-of-two block ≤ preferred dividing rows exactly, or
+    None when no 8..preferred divisor exists (pallas pads ragged trailing
+    blocks with garbage — same rule as flash attention's ``_pick_block``)."""
     if rows <= preferred:
         return rows
     b = preferred
@@ -56,11 +59,22 @@ def _pick_block(rows, preferred):
         if rows % b == 0:
             return b
         b //= 2
-    raise ValueError(
-        "row count {} has no 8..{} block divisor; reshape or pad upstream".format(
-            rows, preferred
+    return None
+
+
+def _pick_block(rows, preferred):
+    """Like :func:`_pick_block_or_none` but raising — for direct
+    :func:`fused_batch_norm` callers, where silently changing the math
+    would be worse than the trace-time error. :class:`FusedBatchNorm`
+    instead falls back to the flax-equivalent path."""
+    b = _pick_block_or_none(rows, preferred)
+    if b is None:
+        raise ValueError(
+            "row count {} has no 8..{} block divisor; reshape or pad upstream".format(
+                rows, preferred
+            )
         )
-    )
+    return b
 
 
 def _compiler_params(interpret):
@@ -302,10 +316,31 @@ class FusedBatchNorm(nn.Module):
             inv = jax.lax.rsqrt(ra_var.value + self.epsilon) * scale
             y = (x.astype(jnp.float32) - ra_mean.value) * inv + bias
             return y.astype(out_dtype)
-        y, mean, var = fused_batch_norm(
-            x, scale, bias, eps=self.epsilon,
-            block_r=self.block_r, interpret=self.interpret,
-        )
+        rows = 1
+        for d in x.shape[:-1]:
+            rows *= d
+        if _pick_block_or_none(rows, self.block_r) is None:
+            # e.g. an odd per-shard batch: no power-of-two row block divides
+            # the activation, so the pallas kernels would pad garbage. Fall
+            # back to the flax-equivalent jax spelling (ADVICE r5) instead
+            # of raising at trace time — same math, XLA's own BN lowering.
+            logger.warning(
+                "fused BN: %d rows (shape %s) have no 8..%d block divisor; "
+                "falling back to the plain XLA batch-norm path",
+                rows, x.shape, self.block_r,
+            )
+            xf = x.astype(jnp.float32)
+            axes = tuple(range(x.ndim - 1))
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
+            y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon) * scale + bias
+            mean = jax.lax.stop_gradient(mean)
+            var = jax.lax.stop_gradient(var)
+        else:
+            y, mean, var = fused_batch_norm(
+                x, scale, bias, eps=self.epsilon,
+                block_r=self.block_r, interpret=self.interpret,
+            )
         if not self.is_initializing():
             m = self.momentum
             ra_mean.value = m * ra_mean.value + (1.0 - m) * mean

@@ -268,7 +268,14 @@ class SyncDataParallel:
         With ``donate=True`` (default) both the state and the batch list are
         donated — treat the passed batches as consumed. ``donate="state"``
         donates only the state (for callers that re-feed the same device
-        batches, e.g. synthetic-input benchmarks).
+        batches, e.g. synthetic-input benchmarks). In the packed mode the
+        default ``donate=True`` already means ``"state"``: the ``[K, B,
+        ...]`` input stack aliases no output (a uint8 image stack cannot
+        alias f32 params), so donating it only produced XLA's "donated
+        buffers were not usable" warning and a silent copy (BENCH_r05) —
+        and the prefetch generators keep window buffers referenced for
+        double-buffering, which donation would invalidate. Pass
+        ``donate="batches"`` to force donating the stack anyway.
 
         ``packed=True`` flips the input contract: ``loop(state, stacked)``
         takes ONE device-resident pytree whose leaves carry a leading
@@ -310,7 +317,11 @@ class SyncDataParallel:
             # metrics of the LAST step (scan stacks them; take index -1)
             return state, jax.tree.map(lambda m: m[-1], metrics)
 
-        donate_argnums = {True: (0, 1), "state": (0,), False: ()}[donate]
+        if packed and donate is True:
+            donate = "state"
+        donate_argnums = {
+            True: (0, 1), "batches": (0, 1), "state": (0,), False: (),
+        }[donate]
         return jax.jit(loop, donate_argnums=donate_argnums)
 
     def compile_eval_step(self, metric_fn):
@@ -321,6 +332,65 @@ class SyncDataParallel:
         """Compile ``apply_fn(params, batch) -> predictions``; outputs gather
         to fully-addressable arrays for host-side result queues."""
         return jax.jit(apply_fn, out_shardings=replicated(self.mesh))
+
+
+class PackedLoopCache:
+    """Per-K cache of packed train loops for the adaptive feed.
+
+    The :class:`~tensorflowonspark_tpu.data.autotune.FeedAutotuner` varies
+    the packed-window size K at runtime, but
+    :meth:`SyncDataParallel.compile_train_loop` compiles for a static
+    ``num_steps`` — so each bucket gets its own compiled program, built on
+    first use and reused forever after. With the bounded bucket set
+    (powers of two) that is at most one XLA compile per bucket for the
+    whole run; every compile increments the ``feed_recompiles_total``
+    counter so the trade shows up in ``TFCluster.metrics()``.
+
+    Loops are compiled with the packed donation contract (``donate="state"``
+    — the window buffers stay owned by the prefetch double buffer; see
+    :meth:`SyncDataParallel.compile_train_loop`)::
+
+        cache = PackedLoopCache(strategy, loss_fn, optimizer, mutable=True)
+        for window in autotuned_prefetch(pipe, strategy, tuner=tuner):
+            state, metrics = cache.run(state, window)
+    """
+
+    def __init__(self, strategy, loss_fn, optimizer, has_aux=False, mutable=False):
+        self.strategy = strategy
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.has_aux = has_aux
+        self.mutable = mutable
+        self._loops = {}
+
+    def loop_for(self, num_steps):
+        """The compiled packed loop for window size ``num_steps``."""
+        compiled = self._loops.get(num_steps)
+        if compiled is None:
+            from tensorflowonspark_tpu import obs
+
+            obs.counter(
+                "feed_recompiles_total",
+                help="packed train-loop compilations (bounded by the bucket set)",
+            ).inc()
+            logger.info("compiling packed train loop for window K=%d", num_steps)
+            compiled = self.strategy.compile_train_loop(
+                self.loss_fn, self.optimizer, num_steps,
+                has_aux=self.has_aux, mutable=self.mutable,
+                donate="state", packed=True,
+            )
+            self._loops[num_steps] = compiled
+        return compiled
+
+    def run(self, state, window):
+        """Run one :class:`~tensorflowonspark_tpu.data.autotune.AutotunedWindow`
+        (or any object with ``.data``/``.k``) through its bucket's loop."""
+        return self.loop_for(window.k)(state, window.data)
+
+    @property
+    def compiled_sizes(self):
+        """The buckets compiled so far (sorted)."""
+        return sorted(self._loops)
 
 
 def steps_per_worker(total_examples, batch_size, num_workers, safety=0.9):

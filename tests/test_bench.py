@@ -4,6 +4,8 @@ paths themselves run under BENCH_* env switches, not pytest)."""
 import importlib.util
 import os
 
+import pytest
+
 _BENCH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
 _spec = importlib.util.spec_from_file_location("bench", _BENCH)
 bench = importlib.util.module_from_spec(_spec)
@@ -53,3 +55,77 @@ def test_partition_pairs_boundary_is_inclusive():
 def test_partition_pairs_all_valid():
     valid, invalid = bench.partition_pairs([100.0, 90.0], [99.0, 91.0])
     assert len(valid) == 2 and not invalid
+
+
+def test_partition_pairs_band_is_symmetric():
+    # a train block 12% SLOWER than its paired input-path block is just as
+    # impossible under the pairing model as 12% faster (the r05 0.881 pair:
+    # a relay mood swing landed between the two half-blocks) — both sides
+    # of the band discard
+    valid, invalid = bench.partition_pairs([100.0, 100.0], [88.1, 95.0])
+    assert valid == [(100.0, 95.0)]
+    assert invalid == [(100.0, 88.1)]
+
+
+def test_partition_pairs_low_boundary_is_inclusive():
+    # ratio == 1/1.10 exactly: still valid, mirroring the high boundary
+    valid, invalid = bench.partition_pairs([110.0], [100.0])
+    assert valid and not invalid
+    valid, invalid = bench.partition_pairs([113.0], [100.0])
+    assert invalid and not valid
+
+
+def test_seed_autotuner_solves_the_two_probe_system():
+    """fixed=(K*t_pb - t_win)/(K-1), bw from the residual stream time: a
+    synthetic link with known parameters must round-trip through the probe
+    rates exactly."""
+    from tensorflowonspark_tpu.data import FeedAutotuner
+
+    fixed, bw = 0.25, 20e6
+    # the real bench batch: 64 uint8 images at 224x224x3 (~9.6 MB)
+    batch_imgs, win = 64, 8
+    batch_bytes = 64 * 224 * 224 * 3
+    t_pb = fixed + batch_bytes / bw            # seconds per per-batch transfer
+    t_win = fixed + win * batch_bytes / bw     # seconds per packed window
+    per_batch_rate = batch_imgs / t_pb
+    packed_rate = win * batch_imgs / t_win
+
+    tuner = FeedAutotuner()
+    assert bench.seed_autotuner(
+        tuner, per_batch_rate, packed_rate, win, batch_imgs, batch_bytes
+    )
+    assert tuner.estimator.ready
+    assert tuner.estimator.fixed_s == pytest.approx(fixed, rel=1e-6)
+    assert tuner.estimator.bytes_per_sec == pytest.approx(bw, rel=1e-6)
+    # at these parameters the controller recommends the hand-tuned K=8
+    assert tuner.recommend(batch_bytes) == 8
+
+
+def test_seed_autotuner_refuses_unusable_probes():
+    from tensorflowonspark_tpu.data import FeedAutotuner
+
+    tuner = FeedAutotuner()
+    assert not bench.seed_autotuner(tuner, 0.0, 100.0, 8, 64, 1 << 20)
+    assert not bench.seed_autotuner(tuner, 100.0, 100.0, 1, 64, 1 << 20)
+    assert not tuner.estimator.ready
+
+
+def test_feed_fields_reports_link_estimate_and_stalls():
+    from tensorflowonspark_tpu.data import FeedAutotuner
+
+    tuner = FeedAutotuner()
+    out = bench.feed_fields(tuner, window_k=1, batch_bytes=1 << 20)
+    assert out["window_k"] == 1
+    assert "autotuned_k" not in out  # estimator unseeded: no link estimate
+    assert set(out["stalls"]) == {
+        "producer_read_seconds", "producer_parse_seconds",
+        "producer_emit_seconds", "consumer_wait_seconds",
+    }
+
+    tuner.note_fixed_probe(0.25)
+    tuner.note_transfer(1 << 20, 0.25 + (1 << 20) / 20e6)
+    out = bench.feed_fields(tuner, window_k=8, batch_bytes=1 << 20)
+    assert out["window_k"] == 8
+    assert out["autotuned_k"] in tuner.buckets
+    assert out["link_fixed_cost_seconds"] == pytest.approx(0.25, abs=1e-3)
+    assert out["link_bytes_per_sec"] == pytest.approx(20e6, rel=1e-2)

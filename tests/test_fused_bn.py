@@ -102,6 +102,59 @@ def test_module_matches_flax_module_and_updates_running_stats():
     np.testing.assert_allclose(np.asarray(fe), np.asarray(re), atol=1e-4)
 
 
+def test_odd_rows_fall_back_instead_of_raising(caplog):
+    """An odd per-shard batch (rows=7*5*5=175: no 8..block_r power-of-two
+    divisor) must not crash the module at trace time: the train path logs a
+    warning and falls back to the plain XLA spelling, matching flax BN in
+    forward, running stats, and gradients. Direct ``fused_batch_norm``
+    callers still get the loud error."""
+    import logging
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.standard_normal((7, 5, 5, 32)) * 1.5 + 0.25, jnp.float32)
+    w = jnp.asarray(rng.standard_normal(x.shape), jnp.float32)
+
+    fused = FusedBatchNorm(momentum=0.9, interpret=True, block_r=16)
+    ref = nn.BatchNorm(momentum=0.9, epsilon=1e-5)
+    fvars = fused.init(jax.random.PRNGKey(0), x, use_running_average=False)
+    rvars = ref.init(jax.random.PRNGKey(0), x, use_running_average=False)
+
+    with caplog.at_level(logging.WARNING, logger="tensorflowonspark_tpu.ops.fused_bn"):
+        fy, fmut = fused.apply(fvars, x, use_running_average=False, mutable=["batch_stats"])
+    assert any("falling back" in r.getMessage() for r in caplog.records)
+
+    ry, rmut = ref.apply(rvars, x, use_running_average=False, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(fy), np.asarray(ry), atol=1e-4)
+    for stat, tol in (("mean", 1e-5), ("var", 1e-4)):
+        np.testing.assert_allclose(
+            np.asarray(fmut["batch_stats"][stat]),
+            np.asarray(rmut["batch_stats"][stat]), atol=tol,
+        )
+
+    # gradients flow like flax's (batch-statistics terms included)
+    def make_loss(model, variables):
+        def f(params):
+            y, _ = model.apply(
+                {"params": params, "batch_stats": variables["batch_stats"]},
+                x, use_running_average=False, mutable=["batch_stats"],
+            )
+            return jnp.sum(y * w)
+
+        return f
+
+    got = jax.grad(make_loss(fused, fvars))(fvars["params"])
+    want = jax.grad(make_loss(ref, rvars))(rvars["params"])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3),
+        got, want,
+    )
+
+    gamma = jnp.ones(32, jnp.float32)
+    beta = jnp.zeros(32, jnp.float32)
+    with pytest.raises(ValueError, match="block divisor"):
+        fused_batch_norm(x, gamma, beta, block_r=16, interpret=True)
+
+
 def test_resnet_bn_impl_pallas_trains():
     """resnet56(bn_impl='pallas') runs a forward+backward on CPU (interpret
     mode via the model's backend check) and matches the flax-BN model's loss

@@ -85,6 +85,45 @@ class TestDeterminism:
     def test_seed_changes_the_stream(self, shards):
         assert _stream(shards, seed=1) != _stream(shards, seed=2)
 
+    def test_autotuned_delivery_invariant_to_buckets_and_threads(self, shards):
+        """The adaptive feed composes with the pipeline without touching the
+        record stream: whatever window sizes the controller picks and however
+        many parse threads feed it, the delivered batches are identical."""
+        import jax
+
+        from tensorflowonspark_tpu import parallel
+        from tensorflowonspark_tpu.data import FeedAutotuner, autotuned_prefetch
+        from tensorflowonspark_tpu.train import SyncDataParallel
+
+        strategy = SyncDataParallel(parallel.build_mesh({"dp": 8}))
+
+        def delivered(num_threads, buckets):
+            pipe = ImagePipeline(
+                shards, _parse, batch_size=8, seed=3, epochs=1,
+                num_threads=num_threads,
+            )
+            tuner = FeedAutotuner(buckets=buckets)
+            out = []
+            for w in autotuned_prefetch(iter(pipe), strategy, tuner=tuner):
+                assert w.k in tuner.buckets
+                data = jax.device_get(w.data)
+                for i in range(w.k):
+                    out.append(
+                        (
+                            np.asarray(data["image"])[i].tobytes(),
+                            np.asarray(data["label"])[i].tolist(),
+                        )
+                    )
+            return out
+
+        base = delivered(1, (1,))
+        assert len(base) == 411 // 8
+        # the K=1 reference matches the raw host stream record for record
+        host = _stream(shards, epochs=1, num_threads=1)
+        assert [img for img, _ in base] == [img for img, _ in host]
+        for threads, buckets in [(1, (1, 2, 4)), (8, (1,)), (8, (1, 2, 4)), (8, (1, 4, 16))]:
+            assert delivered(threads, buckets) == base, (threads, buckets)
+
     def test_invalid_cache_mode_rejected(self, shards):
         with pytest.raises(ValueError):
             ImagePipeline(shards, _parse, batch_size=8, cache="disk")

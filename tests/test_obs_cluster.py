@@ -24,8 +24,15 @@ def fn_square_feed_with_metric(args, ctx):
     # the jax child's process-global registry: published periodically by the
     # SnapshotPublisher the node runtime starts
     from tensorflowonspark_tpu import obs
+    from tensorflowonspark_tpu.data import FeedAutotuner
 
     obs.counter("child_marks_total", help="one per node main_fun entry").inc()
+    # the feed autotuner publishes its link estimate and window choice into
+    # the same registry (pure controller API: no device traffic needed)
+    tuner = FeedAutotuner()
+    tuner.note_fixed_probe(0.25)
+    tuner.note_transfer(1 << 20, 0.25 + 0.05)
+    tuner.decide(1 << 20)
     feed = ctx.get_data_feed(train_mode=False)
     while not feed.should_stop():
         batch = feed.next_batch(16)
@@ -66,6 +73,28 @@ class TestClusterMetrics:
             assert set(snap["nodes"]) == {"worker:0", "worker:1"}
             for node_snap in snap["nodes"].values():
                 assert node_snap["counters"]["child_marks_total"]["value"] == 1
+            # the adaptive feed's five metrics cross the channel: gauges and
+            # counters published by the node-side FeedAutotuner land in the
+            # cluster view
+            for name in (
+                "feed_link_bytes_per_sec",
+                "feed_transfer_fixed_cost_seconds",
+                "feed_window_size",
+                "feed_recompiles_total",
+                "feed_transfer_seconds_total",
+            ):
+                assert (
+                    name in snap["gauges"] or name in snap["counters"]
+                ), name
+            # cross-node gauge semantic is SUM: two nodes x 0.25s fixed cost.
+            # Exact sums are asserted on a driver-free snapshot — the driver
+            # registry is process-global, and a tuner created by an earlier
+            # test in this process would otherwise ride into the sum.
+            nodrv = cluster.metrics(include_driver=False)
+            assert nodrv["gauges"]["feed_transfer_fixed_cost_seconds"]["value"] == pytest.approx(0.5)
+            assert nodrv["counters"]["feed_transfer_seconds_total"]["value"] == pytest.approx(0.6)
+            for node_snap in snap["nodes"].values():
+                assert node_snap["gauges"]["feed_transfer_fixed_cost_seconds"]["value"] == pytest.approx(0.25)
             # lifecycle spans crossed the channel as events
             assert any(e.get("span") == "inference_wave" for e in snap["events"])
             # snapshot is JSON-able end to end (the exporter contract)
