@@ -19,6 +19,9 @@ Modes (BENCH_MODE env):
 * ``serving`` — live InferenceServer rows/sec + p50/p99 request latency,
   N concurrent clients, coalescing ON vs OFF (``vs_baseline`` = the
   coalescing speedup over one-dispatch-per-request).
+* ``ckpt`` — training-thread stall per checkpoint save, blocking
+  ``save_checkpoint`` vs the async engine's snapshot-only cost
+  (``vs_baseline`` = the stall speedup; see docs/perf.md).
 * ``mnist_epoch`` — BASELINE.json metric 2, "MNIST epoch time
   (InputMode.SPARK)": wall-clock seconds to push one epoch of MNIST-shaped
   rows through a live 1-worker cluster's feed plane (reservation server,
@@ -889,6 +892,69 @@ def bench_serving(tiny):
     }
 
 
+def bench_ckpt(tiny):
+    """``BENCH_MODE=ckpt`` — training-thread checkpoint stall, blocking vs
+    async. The blocking leg is the pre-engine path (``save_checkpoint``
+    parks the loop on the orbax write + fsync); the async leg pays only the
+    snapshot-to-host copy (``AsyncCheckpointEngine.save``) while the writer
+    commits in the background. Drains between async saves are untimed so
+    every stall sample measures one snapshot, never queue backlog.
+    ``vs_baseline`` is the stall speedup (blocking / async median)."""
+    import shutil
+    import statistics
+    import sys
+    import tempfile
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import ckpt as ckpt_pkg
+    from tensorflowonspark_tpu.train import checkpoint
+
+    mb = int(os.environ.get("BENCH_CKPT_MB", "4" if tiny else "64"))
+    saves = int(os.environ.get("BENCH_CKPT_SAVES", "3" if tiny else "8"))
+    n_leaves = 8
+    leaf = max(1, mb * (1 << 20) // (4 * n_leaves))
+    rng = np.random.default_rng(0)
+    state = {"step": np.zeros((), np.int64)}
+    for i in range(n_leaves):
+        state["w{}".format(i)] = rng.standard_normal(leaf).astype(np.float32)
+
+    tmp = tempfile.mkdtemp(prefix="bench_ckpt_")
+    blocking, async_stall = [], []
+    try:
+        bdir = os.path.join(tmp, "blocking")
+        for s in range(1, saves + 1):
+            t0 = time.perf_counter()
+            checkpoint.save_checkpoint(os.path.join(bdir, "ckpt_{}".format(s)), state)
+            blocking.append(time.perf_counter() - t0)
+        adir = os.path.join(tmp, "async")
+        with ckpt_pkg.AsyncCheckpointEngine(adir) as eng:
+            for s in range(1, saves + 1):
+                t0 = time.perf_counter()
+                eng.save(state, s)
+                async_stall.append(time.perf_counter() - t0)
+                eng.drain(timeout=600)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    b_med = statistics.median(blocking)
+    a_med = statistics.median(async_stall)
+    print(
+        "ckpt stall per save ({} MB state, {} saves): blocking {} s | "
+        "async snapshot {} s".format(
+            mb, saves,
+            [round(t, 4) for t in blocking], [round(t, 4) for t in async_stall],
+        ),
+        file=sys.stderr,
+    )
+    return {
+        "metric": "ckpt_train_thread_stall_seconds",
+        "value": round(a_med, 4),
+        "unit": "seconds the training thread stalls per save ({} MB state, "
+                "async engine; blocking save {:.3f}s)".format(mb, b_med),
+        "vs_baseline": round(b_med / a_med, 2),
+    }
+
+
 def main():
     from tensorflowonspark_tpu import util
 
@@ -898,11 +964,13 @@ def main():
     # feed -> fused train loop), per VERDICT r2: synthetic-data numbers skip
     # the part of the system most likely to be the bottleneck
     mode = os.environ.get("BENCH_MODE", "resnet_real")
-    _force_platform_for_tiny(tiny or mode in ("mnist_epoch", "feed_plane"))
+    _force_platform_for_tiny(tiny or mode in ("mnist_epoch", "feed_plane", "ckpt"))
     if mode == "mnist_epoch":
         result = bench_mnist_epoch()
     elif mode == "feed_plane":
         result = bench_feed_plane()
+    elif mode == "ckpt":
+        result = bench_ckpt(tiny)
     elif mode == "lm":
         result = bench_lm(tiny)
     elif mode == "serving":

@@ -52,6 +52,11 @@ MODULES = [
     "tensorflowonspark_tpu.parallel.pipeline_parallel",
     "tensorflowonspark_tpu.train.strategy",
     "tensorflowonspark_tpu.train.checkpoint",
+    "tensorflowonspark_tpu.ckpt",
+    "tensorflowonspark_tpu.ckpt.engine",
+    "tensorflowonspark_tpu.ckpt.snapshot",
+    "tensorflowonspark_tpu.ckpt.manifest",
+    "tensorflowonspark_tpu.ckpt.reshard",
     "tensorflowonspark_tpu.train.export",
     "tensorflowonspark_tpu.train.metrics",
     "tensorflowonspark_tpu.data.loader",

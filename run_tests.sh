@@ -18,6 +18,9 @@
 #                 plus the adaptive-feed leg (sleep-staged data.device_link
 #                 latency: the autotuner must ratchet K up under injected
 #                 latency and bring it back down when the latency clears)
+#                 plus the async-checkpoint overlap leg (a ckpt.write_slow
+#                 stall holds the background writer while the training loop
+#                 keeps stepping — tests/test_ckpt_chaos.py::TestOverlap)
 #   --analyze     print the full tosa static-analysis report as JSON and exit
 #   --native-sanitize  rebuild native/tfrecord_io.cc with ASan+UBSan and run
 #                 the native IO / streaming-chunk tests against it (skips
@@ -103,7 +106,9 @@ if [[ "$CHAOS" == "1" ]]; then
     "data.producer_delay":  {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "data.shard_read":      {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "serving.latency":      {"probability": 0.05, "max_count": null, "delay_s": 0.01},
-    "reservation.slow_accept": {"probability": 0.05, "max_count": null, "delay_s": 0.01}
+    "reservation.slow_accept": {"probability": 0.05, "max_count": null, "delay_s": 0.01},
+    "ckpt.snapshot_stall":  {"probability": 0.05, "max_count": null, "delay_s": 0.01},
+    "ckpt.write_slow":      {"probability": 0.05, "max_count": null, "delay_s": 0.01}
   }}'
   export TOS_CHAOS_LOG="$(mktemp /tmp/tos_chaos_log.XXXXXX)"
   echo "chaos leg: plan active, fault log at $TOS_CHAOS_LOG"

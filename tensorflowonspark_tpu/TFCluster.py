@@ -571,9 +571,16 @@ def run_with_recovery(
     TFCluster.py:178-183); the hard half (resuming the trajectory from the
     latest checkpoint) was delegated to TF's ``load_weights_on_restart``.
     Here the whole loop is driver-side: ``map_fun`` must pick up from
-    ``checkpoint.latest_checkpoint(model_dir)`` when one exists — the
+    ``checkpoint.restore_latest(model_dir)`` when one exists — the
     contract proven end-to-end in ``tests/test_resume.py`` — and this helper
     supplies detection, deterministic teardown, and relaunch around it.
+    Resume prefers **manifest-verified** checkpoints: ``restore_latest``
+    cheap-checks each candidate against its ``MANIFEST.json`` (written last
+    and rename-published by the async engine,
+    :mod:`tensorflowonspark_tpu.ckpt`), skipping torn or bitrotten newest
+    checkpoints with a logged reason instead of attempting doomed restores;
+    if the relaunched cluster has a different worker count,
+    ``ckpt.reshard_restore`` maps the checkpoint onto the new mesh.
 
     Two input modes:
 

@@ -241,11 +241,15 @@ def _child_entry(fn, tf_args, ctx, cluster_meta, error_queue_spec):
                 logger.warning("could not start jax profiler server: %s", e)
         with obs_trace.span("node_main", job=ctx.job_name, task_index=ctx.task_index):
             fn(tf_args, ctx)
+        _drain_checkpoints()
         publisher.stop()  # final flush: short runs publish at least once
         ctx.mgr.set("child_status", "done")
     except BaseException:
         tb = traceback.format_exc()
         logger.error("user main_fun failed:\n%s", tb)
+        # land any in-flight async checkpoint BEFORE reporting the failure:
+        # the relaunched attempt resumes from the newest committed one
+        _drain_checkpoints()
         try:
             if publisher is not None:
                 publisher.stop()  # flush so the failed node's metrics survive
@@ -259,6 +263,28 @@ def _child_entry(fn, tf_args, ctx, cluster_meta, error_queue_spec):
         except Exception:
             pass
         raise SystemExit(1)
+
+
+#: seconds the exiting jax child waits for in-flight async checkpoint
+#: commits to land (drain-on-exit: an accepted snapshot should become a
+#: resume point, not die with the process)
+CHECKPOINT_DRAIN_TIMEOUT = float(os.environ.get("TOS_CKPT_DRAIN_TIMEOUT", "120"))
+
+
+def _drain_checkpoints():
+    """Drain every live async checkpoint engine in this child — bounded and
+    best-effort: a wedged storage backend must not turn child exit into a
+    hang, and a drain failure must not mask the user fn's own outcome."""
+    try:
+        from tensorflowonspark_tpu import ckpt
+
+        if not ckpt.drain_all(timeout=CHECKPOINT_DRAIN_TIMEOUT):
+            logger.warning(
+                "async checkpoint drain timed out after %ss on child exit",
+                CHECKPOINT_DRAIN_TIMEOUT,
+            )
+    except Exception:
+        logger.exception("async checkpoint drain failed on child exit")
 
 
 #: seconds between child heartbeats on the IPC channel (the driver-side

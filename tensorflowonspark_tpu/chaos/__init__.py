@@ -56,8 +56,18 @@ site                            effect at the injection point
                                 (probes and windows), so injected latency
                                 flows into the link estimate and the window
                                 size K must adapt
-``checkpoint.corrupt_write``    newest checkpoint left torn on disk
+``checkpoint.corrupt_write``    newest checkpoint left torn on disk (in the
+                                async engine: shard bitrot after the
+                                manifest, caught by cheap-verify)
 ``checkpoint.restore_fail``     restore raises ``IOError``
+``ckpt.snapshot_stall``         snapshot-to-host copy sleeps before copying
+                                (``delay_s``) — the training-thread cost
+``ckpt.write_slow``             background checkpoint writer sleeps
+                                (``delay_s``) inside the timed write region
+``ckpt.commit_tear``            commit dies between shard write and publish:
+                                staging dir left unpublished; with
+                                ``publish_torn: true`` the rename happens
+                                over a half-written manifest instead
 ``serving.latency``             predictor sleeps before dispatch
 ``serving.conn_drop``           server closes the connection mid-request
 ``serving.overload``            submit sheds with ``Overloaded``

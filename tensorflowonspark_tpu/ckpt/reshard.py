@@ -1,0 +1,78 @@
+"""Resharded restore: map a checkpoint onto a *different* mesh.
+
+The elastic-recovery case: a cluster checkpoints on a 1×N mesh, a worker
+dies, and ``run_with_recovery`` relaunches with a different device count —
+the restored arrays must land on the new mesh under the new partition
+specs. Orbax records the shardings a checkpoint was *saved* with; instead
+of fighting that metadata, the restore here is deliberately two-phase:
+
+1. restore the checkpoint to plain host numpy arrays (mesh-free), then
+2. ``device_put`` every leaf under the placement the **new** strategy
+   derives for it (params via ``param_shardings``, optimizer state via the
+   structural matcher, step/model_state replicated — exactly the placement
+   ``create_state`` would produce).
+
+Host memory bounds this (phase 1 materializes full arrays on the host),
+which is the right trade for the recovery path: it is rare, correctness
+matters more than peak speed, and it works for any source→target mesh pair
+including shape-incompatible ones. Single-controller scope: each process
+restores onto its own (local) mesh — the multi-host jax child world
+restores per-process like every other placement in this repo.
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def state_shardings(strategy, state):
+    """The NamedSharding pytree ``strategy`` assigns a TrainState (or bare
+    pytree) — computable from a restored *host* state: only leaf shapes and
+    dtypes are consulted, matching ``create_state``'s placement."""
+    from tensorflowonspark_tpu.parallel import replicated
+    from tensorflowonspark_tpu.train.strategy import TrainState
+
+    import jax
+
+    rep = replicated(strategy.mesh)
+    if isinstance(state, TrainState):
+        return TrainState(
+            rep,
+            strategy.param_shardings(state.params),
+            strategy._opt_shardings(state),
+            jax.tree.map(lambda _: rep, state.model_state),
+        )
+    return jax.tree.map(lambda _: rep, state)
+
+
+def reshard_restore(path, strategy=None, target=None, shardings=None):
+    """Restore the checkpoint at ``path`` onto a new mesh / partition spec.
+
+    ``strategy`` (a :class:`~tensorflowonspark_tpu.train.strategy.
+    SyncDataParallel` built on the NEW mesh) derives the target placement;
+    pass an explicit ``shardings`` pytree instead for custom layouts.
+    ``target`` (optional) supplies tree structure for the host restore —
+    device-resident targets are host-ified first, so a fresh state created
+    on the new mesh can be passed directly.
+
+    Returns the state device-resident under the new placement. Values are
+    bit-identical to the saved ones — resharding moves bytes, it never
+    recomputes them.
+    """
+    import jax
+
+    from tensorflowonspark_tpu.train import checkpoint as _ckpt
+
+    if strategy is None and shardings is None:
+        raise ValueError("reshard_restore needs a strategy or explicit shardings")
+    if target is not None:
+        target = jax.device_get(target)
+    host = _ckpt.restore_checkpoint(path, target=target)
+    if shardings is None:
+        shardings = state_shardings(strategy, host)
+    placed = jax.tree.map(lambda x, s: jax.device_put(x, s), host, shardings)
+    logger.info(
+        "resharded checkpoint %s onto mesh %s", path,
+        getattr(strategy, "mesh", None),
+    )
+    return placed

@@ -14,6 +14,7 @@ _EXPORTS = {
     "PackedLoopCache": "strategy",
     "TrainState": "strategy",
     "steps_per_worker": "strategy",
+    "run_steps": "strategy",
     "checkpoint": None,
     "strategy": None,
     "export": None,

@@ -13,6 +13,8 @@ import logging
 import os
 
 from tensorflowonspark_tpu import chaos, obs
+from tensorflowonspark_tpu.ckpt import manifest as ckpt_manifest
+from tensorflowonspark_tpu.ckpt.engine import TMP_MARKER
 
 logger = logging.getLogger(__name__)
 
@@ -72,6 +74,9 @@ def save_checkpoint(path, state, force=True):
     ckptr = _checkpointer()
     ckptr.save(path, _to_saveable(state), force=force)
     ckptr.wait_until_finished()
+    # manifest AFTER the full write, BEFORE the chaos tear: sync saves get
+    # the same cheap-verify integrity story as the async engine's commits
+    ckpt_manifest.write_manifest(path)
     if chaos.active and chaos.fire("checkpoint.corrupt_write"):
         _tear_checkpoint(path)
     logger.info("saved checkpoint to %s", path)
@@ -142,6 +147,11 @@ def _numbered_checkpoints(model_dir, prefix="ckpt_"):
     steps = []
     for name in os.listdir(model_dir):
         sub = os.path.join(model_dir, name)
+        if name.startswith(TMP_MARKER):
+            # uncommitted staging dir of an async-engine commit in progress
+            # (or torn by a crash): never a restore candidate, never pruned
+            # here — even under prefix="" its *_<digits> tail would match
+            continue
         if os.path.isdir(sub) and name.startswith(prefix):
             tail = name.rsplit("_", 1)[-1]
             if tail.isdigit():
@@ -177,50 +187,94 @@ def restore_latest(model_dir, target=None, prefix="ckpt_"):
     """Restore the newest *restorable* checkpoint under ``model_dir``.
 
     Walks step-numbered checkpoints newest-first and returns
-    ``(state, path)``; a checkpoint that fails to restore (torn write from a
-    crashed host, truncated array file) is skipped with a warning and a
-    ``checkpoint_restore_fallbacks_total`` count, and the next-older one is
-    tried — the resume contract survives a corrupt newest checkpoint instead
-    of dying on it. Returns ``(None, None)`` when nothing is restorable;
-    the last restore error re-raises only if every checkpoint failed AND the
-    caller had at least one to try (so "no checkpoints yet" stays a clean
-    fresh start)."""
+    ``(state, path)``. Manifest-carrying checkpoints (every async-engine
+    commit and post-manifest sync save) are **cheap-verified first** —
+    stat + CRC32 against ``MANIFEST.json`` — so a torn or bitrotten
+    candidate is rejected without paying for (or trusting) a full orbax
+    restore attempt; legacy manifest-less checkpoints keep the
+    attempt-the-restore contract. Every skipped candidate is logged with
+    *which* checkpoint was skipped and *why* (torn manifest, checksum
+    mismatch, restore exception) and counted in
+    ``checkpoint_restore_fallbacks_total``; a final warning summarizes the
+    skips when an older checkpoint wins. Returns ``(None, None)`` when the
+    directory has no checkpoints at all; raises only if every candidate
+    failed (so "no checkpoints yet" stays a clean fresh start)."""
     steps = _numbered_checkpoints(model_dir, prefix)
     if not steps:
         latest_checkpoint(model_dir, prefix)  # emit the prefix-mismatch warning
         return None, None
     last_err = None
+    skipped = []  # (path, reason) — the resume audit trail
+
+    def _skip(path, reason):
+        skipped.append((path, reason))
+        obs.counter(
+            "checkpoint_restore_fallbacks_total",
+            help="checkpoints skipped as unrestorable during resume",
+        ).inc()
+        logger.warning(
+            "skipping checkpoint %s: %s; falling back to an older one",
+            path, reason,
+        )
+
     for _step, path in reversed(steps):
+        ok, reason = ckpt_manifest.verify(path)
+        if not ok:
+            _skip(path, reason)
+            continue
         try:
-            return restore_checkpoint(path, target), path
+            state = restore_checkpoint(path, target)
         except Exception as e:
             last_err = e
-            obs.counter(
-                "checkpoint_restore_fallbacks_total",
-                help="checkpoints skipped as unrestorable during resume",
-            ).inc()
+            _skip(path, "restore failed ({})".format(e))
+            continue
+        if skipped:
             logger.warning(
-                "checkpoint %s is unrestorable (%s); falling back to an older one",
-                path, e,
+                "resumed from %s after skipping %d newer checkpoint(s): %s",
+                path, len(skipped),
+                "; ".join(
+                    "{}: {}".format(os.path.basename(p), r) for p, r in skipped
+                ),
             )
-    raise last_err
+        return state, path
+    if last_err is not None:
+        raise last_err
+    raise IOError(
+        "no restorable checkpoint under {}: {}".format(
+            model_dir,
+            "; ".join("{}: {}".format(os.path.basename(p), r) for p, r in skipped),
+        )
+    )
 
 
-def prune_checkpoints(model_dir, keep):
+def prune_checkpoints(model_dir, keep, in_flight=None):
     """Delete all but the newest ``keep`` step-numbered checkpoints (the
     ``tf.train.CheckpointManager(max_to_keep=...)`` capability: params +
     optimizer state add up fast on long runs and only the newest feeds the
     resume contract). Concurrent pruning by multiple saver processes is
     harmless — deletions race only against each other, on dirs nobody reads
-    again. Returns the number of checkpoints removed."""
+    again. Returns the number of checkpoints removed.
+
+    Two guards keep pruning safe against the async engine: uncommitted
+    ``tmp.*`` staging dirs are never enumerated (``_numbered_checkpoints``
+    skips them), and any path in the engine's in-flight registry
+    (:func:`tensorflowonspark_tpu.ckpt.engine.in_flight_paths`, or the
+    explicit ``in_flight`` override) is exempt — a checkpoint mid-commit
+    must never be deleted out from under its writer, even when a flood of
+    newer commits would otherwise age it out."""
     import shutil
 
     if keep <= 0:
         return 0
+    if in_flight is None:
+        from tensorflowonspark_tpu.ckpt.engine import in_flight_paths
+
+        in_flight = in_flight_paths()
+    busy = {os.path.abspath(os.path.expanduser(p)) for p in in_flight}
     # same ckpt_ gate as latest_checkpoint: rmtree must never touch sibling
     # numbered dirs the user owns (export versions, run_3, ...)
     ckpts = _numbered_checkpoints(model_dir)
-    doomed = ckpts[:-keep]
+    doomed = [(step, path) for step, path in ckpts[:-keep] if path not in busy]
     for _, path in doomed:
         shutil.rmtree(path, ignore_errors=True)
     return len(doomed)

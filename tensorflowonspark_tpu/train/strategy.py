@@ -393,6 +393,54 @@ class PackedLoopCache:
         return sorted(self._loops)
 
 
+def run_steps(step_fn, state, batches, engine=None, save_every_n=None, hooks=()):
+    """Drive a compiled step over ``batches`` with per-step hooks and
+    non-blocking checkpointing. Returns ``(state, last_metrics)``.
+
+    The loop hook for the async checkpoint engine
+    (:class:`tensorflowonspark_tpu.ckpt.AsyncCheckpointEngine`): every
+    ``save_every_n`` steps (default: the engine's own cadence) the state is
+    snapshotted to host — the only checkpoint cost the training thread ever
+    pays — and committed in the background; on exit (including an exception
+    unwinding through the loop) the engine is **drained** so the final
+    snapshot lands before the caller tears anything down.
+
+    Donation-safe by ordering: ``step_fn`` may donate its state argument —
+    the snapshot copies the *returned* state to host buffers the engine
+    owns before the next iteration donates those device arrays back into
+    ``step_fn``, so the background writer never aliases live device memory.
+
+    ``hooks`` are callables ``hook(state, global_step, metrics)`` run after
+    every step (eval triggers, LR logging). The global step is tracked
+    host-side from one initial ``state.step`` readback — per-step device
+    syncs would serialize the dispatch pipeline this loop exists to keep
+    full.
+    """
+    import jax
+
+    if isinstance(state, dict):  # bare-pytree states carry step as a key
+        start = state.get("step", 0)
+    else:
+        start = getattr(state, "step", 0)
+    start_step = int(jax.device_get(start))
+    cadence = save_every_n if save_every_n is not None else (
+        engine.save_every_n if engine is not None else 0
+    )
+    metrics = None
+    try:
+        for i, batch in enumerate(batches):
+            state, metrics = step_fn(state, batch)
+            global_step = start_step + i + 1
+            for hook in hooks:
+                hook(state, global_step, metrics)
+            if engine is not None and cadence and global_step % cadence == 0:
+                engine.save(state, global_step)
+    finally:
+        if engine is not None:
+            engine.drain()
+    return state, metrics
+
+
 def steps_per_worker(total_examples, batch_size, num_workers, safety=0.9):
     """Per-worker step budget for InputMode.SPARK feeding.
 
