@@ -23,6 +23,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 MODULES = [
     "tensorflowonspark_tpu",
     "tensorflowonspark_tpu.TFCluster",
+    "tensorflowonspark_tpu.elastic",
     "tensorflowonspark_tpu.TFSparkNode",
     "tensorflowonspark_tpu.TFNode",
     "tensorflowonspark_tpu.TFManager",

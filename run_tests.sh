@@ -97,6 +97,13 @@ if [[ "$PERF_SMOKE" == "1" ]]; then
 fi
 
 if [[ "$CHAOS" == "1" ]]; then
+  # node.kill leg (first, before the benign env plan is exported — the test
+  # installs its own single-victim plan): the recovery ladder under a
+  # deterministic victim kill — blacklist after repeated loss, shrink-to-fit
+  # relaunch, resharded resume, recovery counters asserted from the merged
+  # cluster metrics.
+  echo "chaos leg: node.kill recovery-ladder run"
+  python -m pytest tests/test_elastic.py -q -m "chaos and slow"
   # Benign (delay-only) sites at low probability: the suite's assertions
   # must keep passing — chaos here perturbs timing, not outcomes. Error
   # faults get exercised deterministically by tests/test_chaos_*.py.

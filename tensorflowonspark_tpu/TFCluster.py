@@ -149,14 +149,28 @@ class TFCluster:
 
         def _monitor():
             reported = set()
+            poll_errors_logged = set()  # log an unreachable channel once per node
             while not stop.wait(interval):
                 for row in self.cluster_info or []:
                     if not row.get("manager_addr") or row["executor_id"] in reported:
                         continue
                     try:
                         problem = _poll_node(row)
-                    except Exception:
-                        continue  # channel unreachable: shutdown's concern
+                    except Exception as e:
+                        # channel unreachable: shutdown's concern — but count
+                        # it, so a node the watchdog can never see is visible
+                        obs_registry.counter(
+                            "watchdog_poll_errors_total",
+                            help="watchdog node polls that raised (channel unreachable)",
+                        ).inc()
+                        if row["executor_id"] not in poll_errors_logged:
+                            poll_errors_logged.add(row["executor_id"])
+                            logger.debug(
+                                "watchdog: cannot poll node %s:%s: %s",
+                                row["job_name"], row["task_index"], e,
+                            )
+                        continue
+                    poll_errors_logged.discard(row["executor_id"])
                     if problem:
                         reported.add(row["executor_id"])
                         logger.error("watchdog: %s", problem)
@@ -604,6 +618,18 @@ def run_with_recovery(
     reach NAT'd nodes. Leave ``None`` for reachable clusters — a legitimate
     training run can take arbitrarily long.
 
+    The attempt loop itself is the **recovery ladder**
+    (:func:`tensorflowonspark_tpu.elastic.run_ladder`): failures are
+    classified into a :class:`~tensorflowonspark_tpu.elastic.FailureLedger`,
+    executors with repeated attributable losses are blacklisted (after a
+    preflight health probe), and the relaunch shrinks to the surviving
+    capacity — ``map_fun`` resharding onto the smaller mesh via
+    ``ckpt.reshard_restore``. Ladder knobs (``min_workers``,
+    ``blacklist_after``, ``window_secs``, ``preflight``, ``regrow``) pass
+    through ``**run_kwargs``; the defaults reproduce the historical
+    behaviour for single transient faults (one failure → full-size
+    relaunch).
+
     Returns the number of relaunches performed (0 = clean first run).
     """
     mode = run_kwargs.get("input_mode", InputMode.SPARK)
@@ -614,51 +640,34 @@ def run_with_recovery(
         )
     if mode == InputMode.TENSORFLOW and feed_fn is not None:
         raise ValueError("feed_fn requires input_mode=InputMode.SPARK")
-    attempt = 0
-    while True:
-        failure = None
-        cluster = None
-        try:
-            cluster = run(sc, map_fun, tf_args, num_executors, **run_kwargs)
-        except Exception as e:
-            failure = e
-        if cluster is not None:
-            try:
-                if feed_fn is not None:
-                    # SPARK mode: drive the caller's feed; a dead node
-                    # surfaces as a feed-task exception (queue timeout) or
-                    # as a watchdog error raced past the feed's return
-                    feed_fn(cluster)
-                    cluster.check_errors()
-                else:
-                    # wait for training to finish, cutting out early on a
-                    # detected node failure (watchdog error-queue peek /
-                    # heartbeat loss); NOT a launch-thread join — ps/
-                    # evaluator tasks park until shutdown, so the launch
-                    # job outlives training by design
-                    cluster.wait_for_completion(poll_secs, timeout=completion_timeout)
-                cluster.shutdown(timeout=shutdown_timeout)
-                return attempt
-            except Exception as e:
-                failure = e
-        attempt += 1
-        # tear the failed attempt down BEFORE deciding whether to relaunch:
-        # on the final failure the caller still gets their executors back
-        if cluster is not None:
-            cluster.abort("attempt {} failed: {}".format(attempt, failure))
-        if attempt > max_relaunches:
-            raise RuntimeError(
-                "training failed after {} relaunch(es): {}".format(attempt - 1, failure)
-            ) from failure
-        logger.warning(
-            "cluster attempt %d failed (%s); survivors aborted, relaunching",
-            attempt, failure,
-        )
+    from tensorflowonspark_tpu import elastic
+
+    result = elastic.run_ladder(
+        sc,
+        map_fun,
+        tf_args,
+        num_executors,
+        max_relaunches=max_relaunches,
+        poll_secs=poll_secs,
+        shutdown_timeout=shutdown_timeout,
+        completion_timeout=completion_timeout,
+        feed_fn=feed_fn,
+        **run_kwargs,
+    )
+    return result.relaunches
 
 
-def build_cluster_template(num_executors, num_ps=0, master_node="chief", eval_node=False):
+def build_cluster_template(num_executors, num_ps=0, master_node="chief", eval_node=False,
+                           blacklist=None):
     """executor_id → (job_name, task_index), in the reference's role order
-    ps → chief → evaluator → worker (TFCluster.py:252-267)."""
+    ps → chief → evaluator → worker (TFCluster.py:252-267).
+
+    ``blacklist`` (executor ids) excludes known-bad hosts from the
+    assignment: roles are laid onto the first ``num_executors`` ids counting
+    from 0 and *skipping* blacklisted ones, so a relaunch after the recovery
+    ladder condemns an executor still gets ``num_executors`` healthy nodes
+    (:mod:`~tensorflowonspark_tpu.elastic`).
+    """
     if master_node is not None and master_node not in ("chief", "master"):
         # catches stringified-None and typos before they become silent
         # do-nothing roles in a live cluster
@@ -678,11 +687,16 @@ def build_cluster_template(num_executors, num_ps=0, master_node="chief", eval_no
             )
         )
     roles.extend(["worker"] * num_workers)
+    banned = frozenset(blacklist or ())
     template, counters = {}, {}
-    for executor_id, job in enumerate(roles):
+    executor_id = 0
+    for job in roles:
+        while executor_id in banned:
+            executor_id += 1
         task_index = counters.get(job, 0)
         counters[job] = task_index + 1
         template[executor_id] = (job, task_index)
+        executor_id += 1
     return template
 
 
@@ -716,6 +730,7 @@ def run(
     env=None,
     jax_distributed=None,
     obs=None,
+    blacklist=None,
 ):
     """Start a cluster: one node per executor (reference TFCluster.py:212-380).
 
@@ -727,6 +742,10 @@ def run(
     in children and feed tasks, snapshot publication, ``TFCluster.metrics()``
     content); default: the driver's ``TOS_OBS`` env setting (on unless
     ``TOS_OBS=0``).
+    ``blacklist`` (executor ids) excludes known-bad executors: the template
+    skips them, the launch RDD never pins a task to them, and the reservation
+    server refuses a late registration from one — the recovery ladder's lever
+    (:mod:`~tensorflowonspark_tpu.elastic`).
     """
     if obs is None:
         obs = os.environ.get("TOS_OBS", "1") != "0"
@@ -735,7 +754,9 @@ def run(
             "driver_ps_nodes: parameter servers have no TPU analogue; ps roles "
             "run on executors for API compatibility only (SURVEY.md §2.6)"
         )
-    template = build_cluster_template(num_executors, num_ps, master_node, eval_node)
+    template = build_cluster_template(num_executors, num_ps, master_node, eval_node,
+                                      blacklist=blacklist)
+    executor_ids = sorted(template)
     num_workers = sum(1 for job, _ in template.values() if job in ("chief", "master", "worker"))
     worker_executor_ids = [
         eid for eid, (job, _) in template.items() if job in ("chief", "master", "worker")
@@ -747,7 +768,9 @@ def run(
         jax_distributed = num_workers > 1
     logger.info("cluster template: %s", {e: "{}:{}".format(j, t) for e, (j, t) in template.items()})
 
-    server = reservation.Server(num_executors)
+    server = reservation.Server(
+        num_executors, expected_ids=executor_ids, blacklist=blacklist
+    )
     server_addr = server.start()
 
     default_fs = resolve_default_fs(sc)
@@ -781,10 +804,13 @@ def run(
     }
 
     tf_status = {}
+    # partition data = the executor ids to launch (non-contiguous under a
+    # blacklist); pinning sends task i to executor executor_ids[i], so a
+    # blacklisted executor hosts nothing
     kwargs = (
-        {"pin_to_executors": True} if getattr(sc, "PIN_SUPPORTED", False) else {}
+        {"pin_to_executors": executor_ids} if getattr(sc, "PIN_SUPPORTED", False) else {}
     )
-    node_rdd = sc.parallelize(range(num_executors), num_executors, **kwargs)
+    node_rdd = sc.parallelize(executor_ids, num_executors, **kwargs)
     launch_task = TFSparkNode.run(
         map_fun, tf_args, cluster_meta, cluster_meta["input_mode"], log_dir, cluster_meta["queues"]
     )

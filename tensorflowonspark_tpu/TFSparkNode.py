@@ -24,6 +24,7 @@ local backend both ship them to executors by serialization).
 
 import logging
 import os
+import signal
 import time
 import traceback
 
@@ -216,7 +217,7 @@ def _child_entry(fn, tf_args, ctx, cluster_meta, error_queue_spec):
         # re-connect our own IPC channel from inside the child
         addr, authkey = error_queue_spec
         ctx.mgr = TFManager.connect(addr, authkey)
-        _start_heartbeat(ctx.mgr)
+        _start_heartbeat(ctx.mgr, ctx.executor_id)
         if not cluster_meta.get("obs", True):
             obs_registry.set_enabled(False)
         # the long-lived child owns this executor's obs_snapshot lane: its
@@ -293,10 +294,39 @@ def _drain_checkpoints():
 HEARTBEAT_INTERVAL = float(os.environ.get("TOS_HEARTBEAT_INTERVAL", "2"))
 
 
-def _start_heartbeat(mgr):
+def _start_heartbeat(mgr, executor_id=None):
     """Daemon thread bumping a counter on the channel every
-    HEARTBEAT_INTERVAL; exits quietly when the channel goes away."""
+    HEARTBEAT_INTERVAL; exits quietly when the channel goes away.
+
+    ``executor_id`` scopes the ``node.kill`` / ``node.flap`` chaos sites:
+    their specs carry a ``victim`` executor id and an ``after_beats`` ramp,
+    so a plan can deterministically take down exactly one node mid-training
+    (the recovery-ladder e2e depends on this precision — a victimless kill
+    site would take out every child, since each spawned process re-installs
+    the plan from the env with a fresh budget).
+    """
     import threading
+
+    def _chaos_node_fault(beat):
+        # gate on the spec params BEFORE rolling the site, so non-victim
+        # nodes and early beats consume neither budget nor counters
+        p = chaos.plan()
+        for site in ("node.kill", "node.flap"):
+            spec = p.sites.get(site) if p else None
+            if spec is None:
+                continue
+            victim = spec.get("victim")
+            if victim is not None and victim != executor_id:
+                continue
+            if beat < spec.get("after_beats", 0):
+                continue
+            if site == "node.kill":
+                if chaos.fire("node.kill"):
+                    logger.warning("chaos: node.kill — SIGKILLing executor %s child",
+                                   executor_id)
+                    os.kill(os.getpid(), signal.SIGKILL)
+            else:
+                chaos.delay("node.flap")  # paused beats: watchdog sees a gap
 
     def _beat():
         failures = 0
@@ -304,6 +334,8 @@ def _start_heartbeat(mgr):
             base=HEARTBEAT_INTERVAL, factor=1.0, max_delay=HEARTBEAT_INTERVAL, jitter=0.0
         )
         for n in ticker.attempts():
+            if chaos.active:
+                _chaos_node_fault(n)
             try:
                 mgr.set("heartbeat", n)
                 failures = 0
@@ -919,6 +951,121 @@ class _ShutdownPartitionTask:
         return []
 
 
+class _PreflightTask:
+    """Per-executor health probe run as a short Spark task *between* cluster
+    attempts (the recovery ladder's health gate, :mod:`~tensorflowonspark_tpu.elastic`).
+
+    Each partition carries one executor id. The probe checks the three
+    resources a relaunch needs from this host — scratch-dir writability,
+    a TCP loopback round-trip (the manager-channel transport), and
+    accelerator visibility — plus the live manager channel when one survives
+    from a previous attempt, and an optional picklable ``extra_probe`` hook.
+    Returns one report dict per executor; a failed check is recorded as its
+    error string, never raised, so one bad host cannot fail the whole gate.
+    """
+
+    def __init__(self, extra_probe=None):
+        self.extra_probe = extra_probe
+
+    def __call__(self, iterator):
+        executor_id = None
+        for i in iterator:
+            executor_id = i
+        if executor_id is None:
+            return []
+        checks = {}
+        checks["scratch"] = self._check_scratch()
+        checks["loopback"] = self._check_loopback()
+        checks["devices"] = self._check_devices()
+        channel = self._check_channel(executor_id)
+        if channel is not None:
+            checks["channel"] = channel
+        if self.extra_probe is not None:
+            try:
+                self.extra_probe(executor_id)
+                checks["extra"] = "ok"
+            except Exception as e:
+                checks["extra"] = "{}: {}".format(type(e).__name__, e)
+        report = {
+            "executor_id": executor_id,
+            "ok": all(v == "ok" for v in checks.values()),
+            "checks": checks,
+        }
+        return [report]
+
+    @staticmethod
+    def _check_scratch():
+        """Write/read/delete a probe file where node scratch state lives."""
+        path = os.path.join(os.getcwd(), ".tos_preflight_{}".format(os.getpid()))
+        try:
+            with open(path, "w") as f:
+                f.write("probe")
+            with open(path) as f:
+                if f.read() != "probe":
+                    return "scratch readback mismatch"
+            os.remove(path)
+            return "ok"
+        except OSError as e:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return "{}: {}".format(type(e).__name__, e)
+
+    @staticmethod
+    def _check_loopback():
+        """TCP round-trip on loopback — the manager channel's transport."""
+        import socket
+
+        try:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.bind(("127.0.0.1", 0))
+            srv.listen(1)
+            srv.settimeout(5.0)
+            cli = socket.create_connection(srv.getsockname(), timeout=5.0)
+            conn, _ = srv.accept()
+            cli.sendall(b"ping")
+            data = conn.recv(4)
+            cli.close()
+            conn.close()
+            srv.close()
+            return "ok" if data == b"ping" else "loopback echo mismatch"
+        except OSError as e:
+            return "{}: {}".format(type(e).__name__, e)
+
+    @staticmethod
+    def _check_devices():
+        """Accelerator visibility without importing jax in the executor."""
+        try:
+            topo = tpu_info.local_topology()
+            if not topo:
+                return "no local topology"
+            return "ok"
+        except Exception as e:
+            return "{}: {}".format(type(e).__name__, e)
+
+    @staticmethod
+    def _check_channel(executor_id):
+        """Round-trip the live manager channel when a previous attempt left
+        one on this executor; None when there is nothing to probe."""
+        mgr = _live_channels.get(executor_id)
+        if mgr is None:
+            state = util.read_executor_state()
+            if state is None or state.get("executor_id") != executor_id:
+                return None
+            try:
+                mgr = TFManager.connect(state["address"], state["authkey"])
+            except Exception as e:
+                return "{}: {}".format(type(e).__name__, e)
+        try:
+            mgr.set("preflight", executor_id)
+            if mgr.get("preflight") != executor_id:
+                return "channel readback mismatch"
+            return "ok"
+        except Exception as e:
+            return "{}: {}".format(type(e).__name__, e)
+
+
 # -- public factory API (names match the reference) ---------------------------
 
 
@@ -942,3 +1089,9 @@ def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input", qname
 def shutdown(cluster_info, cluster_meta, queues=("input",), grace_secs=0):
     del cluster_info
     return _ShutdownPartitionTask(cluster_meta, queues=queues, grace_secs=grace_secs)
+
+
+def preflight(extra_probe=None):
+    """Build the per-executor health-probe closure for
+    ``rdd.mapPartitions(...).collect()`` (see :mod:`~tensorflowonspark_tpu.elastic`)."""
+    return _PreflightTask(extra_probe=extra_probe)

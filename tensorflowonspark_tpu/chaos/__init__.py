@@ -68,6 +68,13 @@ site                            effect at the injection point
                                 staging dir left unpublished; with
                                 ``publish_torn: true`` the rename happens
                                 over a half-written manifest instead
+``node.kill``                   jax child SIGKILLs itself from the heartbeat
+                                loop (``victim``: executor id, ``after_beats``:
+                                beats to wait) — a permanent node loss the
+                                recovery ladder must blacklist and shrink past
+``node.flap``                   heartbeat loop stalls ``delay_s`` (``victim``,
+                                ``after_beats`` as above) — a transient loss
+                                that should NOT lead to a blacklist
 ``serving.latency``             predictor sleeps before dispatch
 ``serving.conn_drop``           server closes the connection mid-request
 ``serving.overload``            submit sheds with ``Overloaded``

@@ -70,8 +70,11 @@ def _leaf_to_host(leaf, out=None):
     if arr is leaf or isinstance(leaf, np.ndarray):
         # device_get passed a host array through unchanged — own a copy
         return np.array(arr, copy=True)
-    if not arr.flags.owndata:
-        # zero-copy view of a (CPU) device buffer: materialize ownership
+    if not arr.flags.owndata or not arr.flags.writeable:
+        # zero-copy view of a (CPU) device buffer, or jax's cached assembly
+        # of a sharded array (owndata but frozen read-only): either way it
+        # cannot serve as a reusable pool buffer — materialize an owned,
+        # writable copy
         return np.array(arr, copy=True)
     return arr
 

@@ -45,19 +45,46 @@ _MAX_MSG = 64 * 1024 * 1024
 
 
 class ReservationError(Exception):
-    """Raised when the cluster cannot be assembled (timeout or node error)."""
+    """Raised when the cluster cannot be assembled (timeout or node error).
+
+    ``missing`` carries the executor ids that never registered (when the
+    server was told which ids to expect) — the recovery ladder's attribution
+    input (:mod:`~tensorflowonspark_tpu.elastic`).
+    """
+
+    def __init__(self, message, missing=None):
+        super().__init__(message)
+        self.missing = list(missing) if missing else []
 
 
 class Reservations:
     """Thread-safe store of node reservations (reference reservation.py:31-65).
 
     ``required`` is the number of reservations that completes the cluster.
+    ``expected_ids`` optionally names the executor ids that should arrive, so
+    a timeout can report *which* nodes never registered instead of just how
+    many.
     """
 
-    def __init__(self, required):
+    def __init__(self, required, expected_ids=None):
         self.required = required
+        self.expected_ids = sorted(expected_ids) if expected_ids else None
         self._lock = threading.Condition()
         self._entries = []
+
+    def missing(self):
+        """Expected executor ids that have not registered yet (sorted).
+
+        Empty when no ``expected_ids`` were declared — the caller falls back
+        to count-based reporting.
+        """
+        if self.expected_ids is None:
+            return []
+        with self._lock:
+            seen = {
+                e.get("executor_id") for e in self._entries if isinstance(e, dict)
+            }
+        return [eid for eid in self.expected_ids if eid not in seen]
 
     def add(self, meta):
         """Add (or idempotently replace) one reservation.
@@ -176,12 +203,19 @@ class Server:
     One instance per cluster. ``start()`` spawns a daemon listener thread
     multiplexing all executor clients with a selector (reference ran a
     select()-loop thread, reservation.py:148-188).
+
+    ``expected_ids`` names the executor ids that should register (enables
+    per-id timeout attribution via :meth:`Reservations.missing`);
+    ``blacklist`` is a set of executor ids whose registrations are refused —
+    the recovery ladder excludes known-bad hosts this way, and a refused
+    executor fails fast instead of silently joining the wrong cluster.
     """
 
-    def __init__(self, count):
+    def __init__(self, count, expected_ids=None, blacklist=None):
         if count <= 0:
             raise ValueError("reservation count must be positive")
-        self.reservations = Reservations(count)
+        self.reservations = Reservations(count, expected_ids=expected_ids)
+        self.blacklist = frozenset(blacklist or ())
         self._stop_requested = threading.Event()
         self._shutdown = threading.Event()
         self._sock = None
@@ -252,10 +286,19 @@ class Server:
                     )
                 if time.time() > deadline:
                     obs.counter("reservation_failures_total").inc()
+                    missing = self.reservations.missing()
+                    detail = (
+                        "; never registered: executors {}".format(missing)
+                        if missing
+                        else ""
+                    )
                     raise ReservationError(
-                        "timed out waiting for {} node(s) to register (of {})".format(
-                            self.reservations.remaining(), self.reservations.required
-                        )
+                        "timed out waiting for {} node(s) to register (of {}){}".format(
+                            self.reservations.remaining(),
+                            self.reservations.required,
+                            detail,
+                        ),
+                        missing=missing,
                     )
                 self.reservations.wait(timeout=poll_interval)
         pending.set(0)
@@ -320,7 +363,19 @@ class Server:
                 # drop the connection before replying: the client sees a
                 # closed stream and re-registers (REG is idempotent)
                 raise OSError("chaos: dropped registration")
-            self.reservations.add(msg.get("data", {}))
+            data = msg.get("data", {})
+            eid = data.get("executor_id") if isinstance(data, dict) else None
+            if eid is not None and eid in self.blacklist:
+                obs.counter(
+                    "reservation_blacklist_rejections_total",
+                    help="REG refused because the executor is blacklisted",
+                ).inc()
+                logger.warning("refusing registration from blacklisted executor %s", eid)
+                msock.send(
+                    {"type": "ERROR", "data": "executor {} is blacklisted".format(eid)}
+                )
+                return
+            self.reservations.add(data)
             obs.counter(
                 "reservation_registrations_total",
                 help="REG messages accepted (retries re-register idempotently)",

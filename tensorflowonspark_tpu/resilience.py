@@ -263,6 +263,10 @@ class CircuitBreaker:
         # caller holds the lock
         if self._state == OPEN and self._clock() - self._opened_at >= self.reset_timeout:
             self._state = HALF_OPEN
+            obs.counter(
+                "circuit_half_open_total",
+                help="circuit breaker open -> half-open transitions",
+            ).inc()
 
     def allow(self):
         """True if a call may proceed (transitions open → half-open when
@@ -293,6 +297,10 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = self._clock()
         obs.counter("resilience_circuit_open_total", help="circuit breaker trips").inc()
+        # cluster-level alias surfaced in TFCluster.metrics() (the
+        # resilience_-prefixed counter predates it and is kept for
+        # dashboard compatibility)
+        obs.counter("circuit_open_total", help="circuit breaker trips").inc()
 
     def call(self, fn, *args, **kwargs):
         """Invoke ``fn`` through the breaker; raises

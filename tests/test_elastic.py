@@ -1,0 +1,397 @@
+"""The recovery ladder: classification, blacklisting, shrink-to-fit.
+
+Unit coverage for :mod:`tensorflowonspark_tpu.elastic` (ledger arithmetic,
+failure classification, the min_workers floor, blacklist-aware templates,
+reservation-server attribution/refusal, the preflight gate) plus the
+end-to-end elasticity story: chaos ``node.kill`` takes a worker down
+mid-training twice → the ledger blacklists it → the relaunch shrinks to the
+surviving capacity → ``ckpt.reshard_restore`` resumes the trajectory on the
+smaller mesh → training completes, with the recovery counters visible in the
+merged cluster metrics snapshot."""
+
+import json
+import os
+import socket
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import TFCluster, chaos, elastic, reservation
+from tensorflowonspark_tpu.TFCluster import InputMode
+from tensorflowonspark_tpu.backends.local import LocalSparkContext
+from tensorflowonspark_tpu.reservation import MessageSocket
+
+CPU_ENV = {"JAX_PLATFORMS": "cpu"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+# -- classification ------------------------------------------------------------
+
+
+class TestClassifyFailure:
+    def test_reservation_timeout_carries_missing_ids(self):
+        err = reservation.ReservationError("timed out", missing=[2, 3])
+        wrapper = RuntimeError("cluster attempt failed")
+        wrapper.__cause__ = err
+        event = elastic.classify_failure(wrapper)
+        assert event.kind == "reservation_timeout"
+        assert event.executor_ids == [2, 3]
+
+    def test_heartbeat_loss_attributed_via_role_map(self):
+        exc = RuntimeError(
+            "cluster failed: node worker:1 stopped heartbeating for 31s "
+            "without a final status (child killed?)"
+        )
+        event = elastic.classify_failure(exc, role_map={"worker:1": 4})
+        assert event.kind == "heartbeat_loss"
+        assert event.executor_ids == [4]
+
+    def test_signal_exit_is_node_exit(self):
+        exc = RuntimeError("node worker:0 failed (exit -9):\n<no output>")
+        event = elastic.classify_failure(exc, role_map={"worker:0": 0})
+        assert event.kind == "node_exit"
+        assert event.executor_ids == [0]
+
+    def test_user_error_exit_is_node_error_not_loss(self):
+        exc = RuntimeError("node worker:0 failed (exit 1):\nTraceback ...")
+        event = elastic.classify_failure(exc, role_map={"worker:0": 0})
+        assert event.kind == "node_error"
+        assert event.kind not in elastic.LOSS_KINDS
+
+    def test_feed_timeout(self):
+        exc = RuntimeError("feed timeout: queue 'input' still has 3 unconsumed items")
+        assert elastic.classify_failure(exc).kind == "feed_timeout"
+
+    def test_unclassifiable_is_unknown(self):
+        event = elastic.classify_failure(ValueError("something odd"))
+        assert event.kind == "unknown"
+        assert event.executor_ids == []
+
+
+# -- ledger --------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestFailureLedger:
+    def test_restart_budget_is_window_scoped(self):
+        clock = FakeClock()
+        ledger = elastic.FailureLedger(max_restarts=2, window_secs=600, clock=clock)
+        ledger.record(elastic.FailureEvent("unknown"))
+        ledger.record(elastic.FailureEvent("unknown"))
+        assert ledger.allow_restart()
+        ledger.record(elastic.FailureEvent("unknown"))
+        assert not ledger.allow_restart()  # 3 failures inside the window
+        clock.t += 601  # the window slides past all three
+        assert ledger.allow_restart()
+        assert ledger.failures_in_window() == 0
+
+    def test_suspects_need_repeated_loss_kind_failures(self):
+        ledger = elastic.FailureLedger(blacklist_after=2)
+        ledger.record(elastic.FailureEvent("node_exit", [1]))
+        assert ledger.suspects() == []  # one transient loss never blacklists
+        ledger.record(elastic.FailureEvent("feed_timeout", [1]))
+        assert ledger.suspects() == []  # non-loss kinds never count
+        ledger.record(elastic.FailureEvent("heartbeat_loss", [1]))
+        assert ledger.suspects() == [1]
+
+    def test_clear_forgives_one_executor(self):
+        ledger = elastic.FailureLedger(blacklist_after=1)
+        ledger.record(elastic.FailureEvent("node_exit", [1]))
+        ledger.record(elastic.FailureEvent("node_exit", [2]))
+        assert ledger.suspects() == [1, 2]
+        ledger.clear(1)
+        assert ledger.suspects() == [2]
+
+    def test_shrink_never_goes_below_min_workers(self):
+        assert elastic.plan_size(4, {3}, min_workers=2) == 3
+        assert elastic.plan_size(4, {1, 3}, min_workers=2) == 2
+        with pytest.raises(RuntimeError, match="min_workers"):
+            elastic.plan_size(4, {1, 2, 3}, min_workers=2)
+        # overhead (ps/evaluator) doesn't count toward the worker floor
+        with pytest.raises(RuntimeError, match="min_workers"):
+            elastic.plan_size(4, {3}, min_workers=3, overhead=1)
+
+
+# -- blacklist threading -------------------------------------------------------
+
+
+class TestBlacklistTemplate:
+    def test_roles_skip_blacklisted_executors(self):
+        template = TFCluster.build_cluster_template(
+            3, master_node="chief", blacklist={1}
+        )
+        assert template == {0: ("chief", 0), 2: ("worker", 0), 3: ("worker", 1)}
+
+    def test_empty_blacklist_is_identical_to_no_blacklist(self):
+        assert TFCluster.build_cluster_template(4, num_ps=1) == (
+            TFCluster.build_cluster_template(4, num_ps=1, blacklist=set())
+        )
+
+
+def _send_reg(addr, executor_id):
+    """One raw REG exchange (no Client: its retry policy would turn the
+    deliberate ERROR reply into seconds of backoff)."""
+    with socket.create_connection(addr, timeout=10) as sock:
+        msock = MessageSocket(sock)
+        msock.send({"type": "REG", "data": {"executor_id": executor_id}})
+        return msock.recv()
+
+
+class TestReservationAttribution:
+    def test_timeout_lists_never_registered_executors(self):
+        server = reservation.Server(2, expected_ids=[0, 1])
+        addr = server.start()
+        try:
+            assert _send_reg(("127.0.0.1", addr[1]), 0)["type"] == "OK"
+            with pytest.raises(reservation.ReservationError) as excinfo:
+                server.await_reservations(timeout=1.0, poll_interval=0.1)
+            assert "never registered: executors [1]" in str(excinfo.value)
+            assert excinfo.value.missing == [1]
+        finally:
+            server.stop()
+
+    def test_blacklisted_registration_is_refused(self):
+        server = reservation.Server(1, expected_ids=[0], blacklist={1})
+        addr = server.start()
+        try:
+            reply = _send_reg(("127.0.0.1", addr[1]), 1)
+            assert reply["type"] == "ERROR"
+            assert "blacklisted" in reply["data"]
+            assert server.reservations.remaining() == 1  # nothing stored
+            # a healthy executor still registers
+            assert _send_reg(("127.0.0.1", addr[1]), 0)["type"] == "OK"
+        finally:
+            server.stop()
+
+
+# -- preflight gate ------------------------------------------------------------
+
+
+def _probe_fail_on_1(executor_id):
+    if executor_id == 1:
+        raise IOError("scratch disk full")
+
+
+class TestPreflight:
+    def test_healthy_executors_pass(self):
+        sc = LocalSparkContext(num_executors=2, task_timeout=120)
+        try:
+            assert elastic.preflight_executors(sc, [0, 1]) == {}
+        finally:
+            sc.stop()
+
+    def test_extra_probe_failure_is_attributed(self):
+        sc = LocalSparkContext(num_executors=2, task_timeout=120)
+        try:
+            bad = elastic.preflight_executors(sc, [0, 1], extra_probe=_probe_fail_on_1)
+            assert list(bad) == [1]
+            assert "disk full" in bad[1]
+        finally:
+            sc.stop()
+
+    def test_unpinnable_backend_reports_nothing(self):
+        class NoPin:
+            pass
+
+        assert elastic.preflight_executors(NoPin(), [0]) == {}
+
+
+# -- final-failure path --------------------------------------------------------
+
+
+def fn_always_dies(args, ctx):
+    raise RuntimeError("synthetic training failure")
+
+
+def test_final_failure_aborts_and_chains_cause(tmp_path, monkeypatch):
+    """When the window budget is spent the ladder must (a) have aborted every
+    failed attempt — the caller gets their executors back — and (b) raise a
+    RuntimeError chaining the last underlying failure."""
+    aborts = []
+    real_abort = TFCluster.TFCluster.abort
+
+    def spying_abort(self, reason="aborted by driver", wait_secs=60):
+        aborts.append(str(reason))
+        return real_abort(self, reason, wait_secs)
+
+    monkeypatch.setattr(TFCluster.TFCluster, "abort", spying_abort)
+    sc = LocalSparkContext(num_executors=1, task_timeout=300)
+    try:
+        with pytest.raises(RuntimeError, match="failed after 1 relaunch") as excinfo:
+            TFCluster.run_with_recovery(
+                sc, fn_always_dies, {}, num_executors=1,
+                input_mode=InputMode.TENSORFLOW, master_node=None,
+                env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
+                max_relaunches=1, shutdown_timeout=120, preflight=False,
+            )
+        assert excinfo.value.__cause__ is not None
+        assert "synthetic training failure" in str(excinfo.value.__cause__)
+    finally:
+        sc.stop()
+    assert len(aborts) == 2  # both failed attempts were torn down
+
+
+# -- end to end: kill → blacklist → shrink → resharded resume ------------------
+
+
+def fn_elastic_train(args, ctx):
+    """Trains to ``target_steps`` on a mesh shaped by the CURRENT cluster
+    size (2 workers → dp=2 × fsdp=4; 1 worker → dp=1 × fsdp=8 on the 8
+    virtual CPU devices), resuming via ``ckpt.reshard_restore`` so a
+    checkpoint saved at one size lands on the other. Only task 0 owns the
+    shared model_dir. The chaos victim (executor 1) trains without a stop
+    condition — it can only ever exit by the injected kill, so the test
+    has no completion-vs-kill race, and the late ``after_beats`` gives
+    task 0 ample runway to commit mid-training checkpoints first."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import ckpt, parallel
+    from tensorflowonspark_tpu.ckpt.reshard import reshard_restore
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.train import SyncDataParallel, checkpoint
+
+    num_workers = ctx.num_workers
+    strategy = SyncDataParallel(
+        parallel.local_mesh({"dp": num_workers, "fsdp": -1}),
+        fsdp=True, min_weight_size=1,
+    )
+    model = mnist.create_model("mlp", hidden=8)
+    optimizer = optax.sgd(0.1)
+    state = strategy.create_state(
+        mnist.make_init_fn(model), optimizer, jax.random.PRNGKey(0)
+    )
+    step = strategy.compile_train_step(
+        mnist.make_loss_fn(model), optimizer, has_aux=True, donate=False
+    )
+    rng = np.random.default_rng(7)
+    batch = strategy.shard_batch(
+        {
+            "image": rng.standard_normal((16, 28, 28)).astype(np.float32),
+            "label": rng.integers(0, 10, 16),
+        }
+    )
+
+    if ctx.executor_id == args["victim"]:
+        # the designated victim never finishes on its own: its only exits
+        # are the injected node.kill (lives at full size) or not being
+        # scheduled at all (after the blacklist) — no timing race
+        while True:
+            state, metrics = step(state, batch)
+            jax.block_until_ready(metrics["loss"])
+            time.sleep(args["step_pace_secs"])
+
+    model_dir = args["model_dir"]
+    resumed_from = 0
+    latest = checkpoint.latest_checkpoint(model_dir)
+    if latest:
+        state = reshard_restore(latest, strategy=strategy, target=state)
+        resumed_from = int(jax.device_get(state.step))
+    global_step = int(jax.device_get(state.step))
+
+    with ckpt.AsyncCheckpointEngine(model_dir) as eng:
+        while global_step < args["target_steps"]:
+            state, metrics = step(state, batch)
+            jax.block_until_ready(metrics["loss"])
+            global_step += 1
+            time.sleep(args["step_pace_secs"])
+            if global_step % 2 == 0:
+                eng.save(state, global_step)
+        assert eng.drain(timeout=120)
+    with open(os.path.join(model_dir, "done.json"), "w") as f:
+        json.dump(
+            {
+                "final_step": global_step,
+                "resumed_from": resumed_from,
+                "num_workers": num_workers,
+                "mesh": dict(strategy.mesh.shape),
+            },
+            f,
+        )
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_node_kill_blacklist_shrink_resharded_resume(tmp_path, monkeypatch):
+    """The elasticity acceptance story: chaos SIGKILLs worker 1 mid-training
+    on every life (fresh per-process plan budget), the ledger attributes two
+    losses to executor 1 and blacklists it, the third attempt launches at
+    N−1 with the 1×8 mesh, reshard-restores the 2×4-mesh checkpoint, and
+    finishes — with the ladder's counters visible in the metrics snapshot
+    captured from ``cluster.metrics()``."""
+    monkeypatch.setenv("TOS_MONITOR_INTERVAL", "1")
+    monkeypatch.setenv("TOS_HEARTBEAT_INTERVAL", "0.2")
+    chaos_log = str(tmp_path / "chaos.log")
+    monkeypatch.setenv(chaos.LOG_ENV_VAR, chaos_log)
+    model_dir = str(tmp_path / "model")
+    os.makedirs(model_dir)
+    args = {
+        "model_dir": model_dir,
+        "target_steps": 12,
+        "step_pace_secs": 0.2,
+        "victim": 1,
+    }
+
+    # victim-scoped: only executor 1's jax child dies, 50 beats (~10s) into
+    # its life — late enough that worker 0 has committed real mid-training
+    # checkpoints by then, while the victim (which never stops on its own)
+    # is still guaranteed to be mid-training. Every relaunch spawns a fresh
+    # child whose plan budget resets, so the victim dies on EVERY life
+    # until the ladder stops scheduling it.
+    plan = chaos.ChaosPlan(seed=11).site(
+        "node.kill", probability=1.0, max_count=1, victim=1, after_beats=50
+    )
+    chaos.install(plan)
+    sc = LocalSparkContext(num_executors=2, task_timeout=900)
+    try:
+        result = elastic.run_ladder(
+            sc, fn_elastic_train, args, num_executors=2,
+            max_relaunches=3, min_workers=1, blacklist_after=2,
+            input_mode=InputMode.TENSORFLOW, master_node=None,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
+            shutdown_timeout=240,
+        )
+    finally:
+        sc.stop()
+        chaos.uninstall()
+
+    # the ladder's trajectory: two full-size failures, then shrink to 1
+    assert result.relaunches == 2
+    assert result.blacklist == {1}
+    assert result.num_executors == 1
+
+    # the kills really came from the chaos site, once per victim life
+    with open(chaos_log) as f:
+        kills = [line for line in f if line.strip() == "node.kill"]
+    assert len(kills) >= 2
+
+    # training completed on the SHRUNK mesh, resuming (not restarting):
+    # the final life restored a checkpoint saved on the 2×4 mesh onto 1×8
+    with open(os.path.join(model_dir, "done.json")) as f:
+        done = json.load(f)
+    assert done["final_step"] == args["target_steps"]
+    assert done["num_workers"] == 1
+    assert done["mesh"] == {"dp": 1, "fsdp": 8}
+    assert done["resumed_from"] >= 1, "final life must resume from a checkpoint"
+
+    # the recovery counters are in the merged cluster metrics snapshot
+    snap = result.metrics
+    assert snap is not None
+    assert snap["counters"]["recovery_attempts_total"]["value"] >= 2
+    assert snap["counters"]["recovery_shrinks_total"]["value"] >= 1
+    assert snap["gauges"]["executors_blacklisted"]["value"] >= 1
+    assert snap["counters"]["recovery_seconds_total"]["value"] > 0
