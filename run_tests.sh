@@ -21,7 +21,9 @@
 #                 plus the async-checkpoint overlap leg (a ckpt.write_slow
 #                 stall holds the background writer while the training loop
 #                 keeps stepping — tests/test_ckpt_chaos.py::TestOverlap)
-#   --analyze     print the full tosa static-analysis report as JSON and exit
+#   --analyze     write the full tosa static-analysis report to
+#                 tosa-report.json and tosa-report.sarif (SARIF 2.1.0 for
+#                 code-scanning upload), print the JSON, and exit
 #   --native-sanitize  rebuild native/tfrecord_io.cc with ASan+UBSan and run
 #                 the native IO / streaming-chunk tests against it (skips
 #                 cleanly when no g++ toolchain is present)
@@ -41,7 +43,7 @@ for arg in "$@"; do
   elif [[ "$arg" == "--perf-smoke" ]]; then
     PERF_SMOKE=1
   elif [[ "$arg" == "--analyze" ]]; then
-    exec python -m tosa --json
+    exec python -m tosa --json --out tosa-report.json --sarif-out tosa-report.sarif
   elif [[ "$arg" == "--native-sanitize" ]]; then
     NATIVE_SANITIZE=1
   else
@@ -49,8 +51,10 @@ for arg in "$@"; do
   fi
 done
 
-# static-analysis gate: jit purity/host-sync, retry & lock discipline,
-# chaos-obs coverage, import hygiene (rule catalog: docs/analysis.md)
+# static-analysis gate, two-phase (per-file walks + project-wide index):
+# jit purity/host-sync, retry & lock discipline, lock-order deadlock
+# detection, chaos-obs coverage, import hygiene, donation safety, and the
+# metrics contract (rule catalog: docs/analysis.md)
 python -m tosa
 
 export JAX_PLATFORMS=cpu

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Pre-commit wrapper for the tosa analyzer: check only what changed.
+
+Collects the changed python files (staged + unstaged against HEAD by
+default, ``--staged`` for the index only, or an explicit file list for
+use from hook frameworks that pass filenames), then runs
+
+    python -m tosa --changed <files...>
+
+which still indexes the default corpus — project-wide rules such as
+lock-order and metrics-contract need the whole program — but reports
+per-file findings only for the changed set. The phase-1 index cache
+(``tools/analyze/.tosa_cache.json``) means the corpus re-index only
+parses files whose content hash changed, so the hook stays fast.
+
+Install as a git hook with::
+
+    ln -s ../../scripts/tosa_precommit.py .git/hooks/pre-commit
+
+Exit status follows ``python -m tosa``: 0 clean, 1 findings, 2 usage.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _git_changed_files(staged_only):
+    """Changed paths relative to the repo root, deduplicated in order."""
+    commands = [["git", "diff", "--name-only", "--cached", "--diff-filter=d"]]
+    if not staged_only:
+        commands.append(["git", "diff", "--name-only", "--diff-filter=d"])
+    seen = {}
+    for cmd in commands:
+        proc = subprocess.run(
+            cmd, cwd=REPO_ROOT, capture_output=True, text=True, check=False
+        )
+        if proc.returncode != 0:
+            print(
+                "tosa-precommit: {} failed: {}".format(
+                    " ".join(cmd), proc.stderr.strip()
+                ),
+                file=sys.stderr,
+            )
+            return None
+        for line in proc.stdout.splitlines():
+            if line:
+                seen[line] = True
+    return list(seen)
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    staged_only = "--staged" in argv
+    if staged_only:
+        argv.remove("--staged")
+
+    if argv:
+        # hook frameworks (and the tests) pass filenames directly
+        changed = argv
+    else:
+        changed = _git_changed_files(staged_only)
+        if changed is None:
+            return 2
+    changed = [
+        p if os.path.isabs(p) else os.path.join(REPO_ROOT, p) for p in changed
+    ]
+    changed = [p for p in changed if p.endswith(".py") and os.path.exists(p)]
+    if not changed:
+        print("tosa-precommit: no changed python files")
+        return 0
+
+    cmd = [sys.executable, "-m", "tosa", "--changed"] + changed
+    return subprocess.call(cmd, cwd=REPO_ROOT)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

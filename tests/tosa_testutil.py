@@ -37,3 +37,33 @@ def run_rule_multi(rule, files):
         checker.end_run(run)
     findings.extend(run.findings)
     return [f for f in findings if f.suppressed is None]
+
+
+def run_project_rule(rule, files, docs=None, keep_suppressed=False):
+    """Run a project-wide rule over in-memory files (``{relpath: source}``)
+    through the two-phase engine: phase-1 index + per-file walks, then
+    ``check_project``. ``docs`` injects documentation text (e.g. a Metrics
+    inventory) keyed by relpath. Returns unsuppressed findings unless
+    ``keep_suppressed``."""
+    from tosa.index import ProjectIndex
+
+    checkers = make_checkers([rule])
+    run = core.RunContext()
+    proj = ProjectIndex(docs=dict(docs or {}))
+    findings = []
+    for relpath, source in files.items():
+        findings.extend(
+            analyze_source(source, relpath, checkers, run=run, project=proj)
+        )
+    for checker in checkers:
+        check_project = getattr(checker, "check_project", None)
+        if check_project is not None:
+            check_project(proj, run)
+        else:
+            checker.end_run(run)
+    for f in run.findings:
+        core._apply_suppressions([f], run.suppressions.get(f.path, {}))
+    findings.extend(run.findings)
+    if keep_suppressed:
+        return findings
+    return [f for f in findings if f.suppressed is None]

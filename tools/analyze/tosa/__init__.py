@@ -1,13 +1,19 @@
 """tosa — TensorFlowOnSpark-TPU static analyzer.
 
-An AST-based invariant checker for this repository: one parse and one
-tree walk per file, with rules as plugins (see ``tosa.checkers``).
+An AST-based invariant checker for this repository. The engine is
+two-phase: phase 1 parses each file once, walks it once for the per-file
+rules, and extracts a project index (symbol tables, call graph, lock and
+metric summaries, donation dataflow); phase 2 runs cross-module rules
+against that index. The index is cached by file content hash, so warm
+runs skip re-parsing unchanged files.
 
 Usage::
 
     python -m tosa                      # analyze the default targets
     python -m tosa --rules jit-purity,retry-discipline path/to/file.py
     python -m tosa --json               # machine-readable report
+    python -m tosa --sarif              # SARIF 2.1.0 report
+    python -m tosa --changed a.py b.py  # pre-commit mode (changed files)
     python -m tosa --write-baseline     # grandfather current findings
     python -m tosa --list-rules
 
@@ -18,13 +24,18 @@ jit-host-sync       no host synchronization inside jit/pjit/shard_map
 jit-purity          traced functions are pure (no effects, clocks, mutation)
 retry-discipline    no bare time.sleep in loops; use resilience primitives
 lock-discipline     cross-thread attribute writes are lock-guarded
+lock-order          lock acquisition order is acyclic project-wide
 chaos-obs-coverage  chaos sites literal, documented, and obs-counted
 import-hygiene      importing the library has no side effects
+donation-safety     device-derived arrays never pooled/mutated/read-after-donation
+metrics-contract    metric names conform, merge upward, and match the docs
 ==================  =======================================================
 
 Findings print as ``file:line: [rule] message``. Silence a single line
-with ``# tosa: disable=<rule> -- <reason>``; grandfather existing debt
-with ``--write-baseline`` (committed at ``tools/analyze/baseline.json``).
+with ``# tosa: disable=<rule> -- <reason>`` (on a ``with``/``for``/
+``while`` header the suppression covers the whole block); grandfather
+existing debt with ``--write-baseline`` (committed at
+``tools/analyze/baseline.json``).
 """
 
 from . import core
@@ -33,21 +44,27 @@ from .core import (
     Checker,
     Finding,
     analyze_files,
+    analyze_project,
     analyze_source,
     gating,
     iter_python_files,
 )
+from .index import ProjectIndex, build_index, summarize
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "ALL_CHECKERS",
     "Checker",
     "Finding",
+    "ProjectIndex",
     "analyze_files",
+    "analyze_project",
     "analyze_source",
+    "build_index",
     "core",
     "gating",
     "iter_python_files",
     "make_checkers",
+    "summarize",
 ]

@@ -3,6 +3,13 @@
 Exit status is 0 when every finding is either inline-suppressed or
 covered by the baseline, 1 when unsuppressed findings remain, 2 on usage
 errors — so ``python -m tosa`` works directly as a CI gate.
+
+Output modes: human (default), ``--json``, ``--sarif`` (SARIF 2.1.0);
+``--out`` / ``--sarif-out`` additionally write the JSON / SARIF reports
+to files, so one run can emit both artifacts. ``--changed FILE...``
+restricts *per-file* findings to the named files while still indexing the
+default corpus, which is what the pre-commit wrapper uses; the phase-1
+index cache (on by default, ``--no-cache`` to disable) makes that fast.
 """
 
 import argparse
@@ -10,13 +17,16 @@ import json
 import os
 import sys
 
-from . import __version__, core
+from . import __version__, core, sarif
 from .checkers import ALL_CHECKERS, make_checkers
 
 #: what a bare ``python -m tosa`` analyzes, relative to the repo root
 DEFAULT_TARGETS = ("tensorflowonspark_tpu", "bench.py", "scripts")
 
 BASELINE_RELPATH = os.path.join("tools", "analyze", "baseline.json")
+
+#: phase-1 index cache, relative to the repo root (gitignored)
+CACHE_RELPATH = os.path.join("tools", "analyze", ".tosa_cache.json")
 
 
 def find_root(start):
@@ -50,6 +60,31 @@ def build_parser():
         help="comma-separated rule ids to run (default: all)",
     )
     p.add_argument("--json", action="store_true", help="emit a JSON report")
+    p.add_argument(
+        "--sarif", action="store_true", help="emit a SARIF 2.1.0 report"
+    )
+    p.add_argument("--out", help="also write the JSON report to this file")
+    p.add_argument(
+        "--sarif-out", help="also write the SARIF 2.1.0 report to this file"
+    )
+    p.add_argument(
+        "--changed",
+        action="store_true",
+        help="targets are a changed-file set: report per-file findings only "
+        "for them, but index the default corpus so project-wide rules "
+        "still see the whole program",
+    )
+    p.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="disable the content-hash phase-1 index cache",
+    )
+    p.add_argument(
+        "--cache",
+        help="index cache path (default: <root>/{})".format(
+            CACHE_RELPATH.replace(os.sep, "/")
+        ),
+    )
     p.add_argument(
         "--baseline",
         help="baseline file (default: <root>/{})".format(
@@ -95,15 +130,46 @@ def main(argv=None):
         print("tosa: {}".format(e.args[0]), file=sys.stderr)
         return 2
 
-    targets = args.targets or [
-        os.path.join(root, t) for t in DEFAULT_TARGETS if os.path.exists(os.path.join(root, t))
+    default_targets = [
+        os.path.join(root, t)
+        for t in DEFAULT_TARGETS
+        if os.path.exists(os.path.join(root, t))
     ]
-    paths = core.iter_python_files(targets)
-    if not paths:
-        print("tosa: no python files under: {}".format(", ".join(targets)), file=sys.stderr)
-        return 2
+    report_only = None
+    if args.changed:
+        if not args.targets:
+            print("tosa: --changed requires explicit file targets", file=sys.stderr)
+            return 2
+        changed_paths = core.iter_python_files(args.targets)
+        report_only = {
+            os.path.relpath(p, root).replace(os.sep, "/") for p in changed_paths
+        }
+        corpus = list(
+            dict.fromkeys(core.iter_python_files(default_targets) + changed_paths)
+        )
+        paths = corpus
+        if not changed_paths:
+            print("tosa: 0 changed python files, nothing to do")
+            return 0
+    else:
+        targets = args.targets or default_targets
+        paths = core.iter_python_files(targets)
+        if not paths:
+            print(
+                "tosa: no python files under: {}".format(", ".join(targets)),
+                file=sys.stderr,
+            )
+            return 2
 
-    findings = core.analyze_files(paths, checkers, root=root)
+    cache_path = None
+    if not args.no_cache:
+        cache_path = args.cache or os.path.join(root, CACHE_RELPATH)
+        if not os.path.isdir(os.path.dirname(cache_path)):
+            cache_path = None
+
+    findings = core.analyze_project(
+        paths, checkers, root=root, cache_path=cache_path, report_only=report_only
+    )
 
     baseline_path = args.baseline or os.path.join(root, BASELINE_RELPATH)
     if args.write_baseline:
@@ -119,15 +185,29 @@ def main(argv=None):
     findings = core.apply_baseline(findings, core.load_baseline(baseline_path))
     gate = core.gating(findings)
 
-    if args.json:
-        report = {
-            "version": __version__,
-            "rules": sorted(c.rule for c in checkers),
-            "files_analyzed": len(paths),
-            "findings": [f.to_dict() for f in findings],
-            "gating": len(gate),
-        }
-        print(json.dumps(report, indent=2, sort_keys=True))
+    json_report = {
+        "version": __version__,
+        "rules": sorted(c.rule for c in checkers),
+        "files_analyzed": len(paths),
+        "findings": [f.to_dict() for f in findings],
+        "gating": len(gate),
+    }
+    sarif_report = None
+    if args.sarif or args.sarif_out:
+        sarif_report = sarif.to_sarif(findings, checkers, __version__)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(json_report, f, indent=2, sort_keys=True)
+            f.write("\n")
+    if args.sarif_out:
+        with open(args.sarif_out, "w", encoding="utf-8") as f:
+            json.dump(sarif_report, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    if args.sarif:
+        print(json.dumps(sarif_report, indent=2, sort_keys=True))
+    elif args.json:
+        print(json.dumps(json_report, indent=2, sort_keys=True))
     else:
         for f in findings:
             if f.suppressed is not None or f.baselined:

@@ -6,10 +6,13 @@ suppression validation all read from this one table.
 """
 
 from .chaos_obs import ChaosObsChecker
+from .donation_safety import DonationSafetyChecker
 from .import_hygiene import ImportHygieneChecker
 from .jit_host_sync import JitHostSyncChecker
 from .jit_purity import JitPurityChecker
 from .lock_discipline import LockDisciplineChecker
+from .lock_order import LockOrderChecker
+from .metrics_contract import MetricsContractChecker
 from .retry_discipline import RetryDisciplineChecker
 
 ALL_CHECKERS = {
@@ -19,8 +22,11 @@ ALL_CHECKERS = {
         JitPurityChecker,
         RetryDisciplineChecker,
         LockDisciplineChecker,
+        LockOrderChecker,
         ChaosObsChecker,
         ImportHygieneChecker,
+        DonationSafetyChecker,
+        MetricsContractChecker,
     )
 }
 

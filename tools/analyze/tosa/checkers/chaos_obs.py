@@ -84,6 +84,27 @@ class ChaosObsChecker(core.Checker):
             return
         self._fired.setdefault(site_arg.value, (ctx.relpath, node.lineno))
 
+    def check_project(self, index, run):
+        """Index-driven variant of :meth:`end_run`: reads chaos facts from
+        the phase-1 summaries so cross-file drift is still detected when
+        per-file walks were skipped (index cache hits)."""
+        table = anchor = counter_seen = None
+        fired = {}
+        for relpath in sorted(index.modules):
+            facts = index.modules[relpath].get("chaos") or {}
+            if "table" in facts:
+                table = {site: site for site in facts["table"]}
+                anchor = (relpath, facts.get("doc_line", 1))
+                counter_seen = facts.get("counter_in_source", False)
+            for site, lineno in facts.get("fires", ()):
+                fired.setdefault(site, (relpath, lineno))
+        if table is None:
+            return  # chaos module not in this scan (fixture runs)
+        self._table, self._table_anchor = table, anchor
+        self._counter_seen = counter_seen
+        self._fired = fired
+        self.end_run(run)
+
     def end_run(self, run):
         if self._table is None:
             return  # chaos module not in this scan (fixture runs)

@@ -1,0 +1,168 @@
+"""donation-safety: values derived from device arrays must not be pooled,
+mutated in place, or read after donation.
+
+The rule interprets the per-function dataflow IR from the phase-1 index
+(see :mod:`..index`) and tracks two taints:
+
+- **device-derived**: results of ``jax.device_get`` and loads of
+  ``.addressable_shards`` (``np.asarray`` *propagates* this taint, it
+  never introduces it). On CPU backends these can be zero-copy views of
+  device buffers, and jax's cached assembly of a sharded array is frozen
+  read-only even when it owns its data — so such a value must not be
+  **written in place** (``arr[i] = ...``, ``np.copyto(arr, ...)``,
+  ``.fill()``/``.sort()``) or **pooled** (stored into an attribute or
+  appended to a container that outlives the call). This is exactly the
+  PR 7 ``ckpt/snapshot.py`` bug: the snapshot pool retained jax's
+  read-only assembly as a reusable slot buffer.
+- **donated**: arguments handed to a ``jax.jit(..., donate_argnums=...)``
+  (or ``compile_train_loop(donate=...)``) callable are invalidated by
+  XLA; reading them after the donating call is undefined behavior. The
+  idiomatic rebind ``state = step(state)`` stays clean because the bind
+  clears the mark.
+
+Cross-function flow: a function whose return value is device-derived
+propagates taint to its callers (a returns-taint fixpoint over the
+project call graph), so ``helper()``-extracted ``device_get`` calls are
+still caught. Reading ``.flags`` on a tainted value sanitizes it — that
+is the in-tree fix's shape: check ``owndata``/``writeable`` and copy
+before pooling.
+"""
+
+from .. import core
+
+
+class DonationSafetyChecker(core.Checker):
+    rule = "donation-safety"
+    description = (
+        "device-derived arrays must not be pooled or mutated in place, and "
+        "donated jit arguments must not be read after the donating call"
+    )
+    interests = ()
+    project = True  # phase-2 rule: runs off the project index
+
+    def check_project(self, index, run):
+        taints_ret = {}
+        for _ in range(6):  # returns-taint fixpoint (call-graph depth bound)
+            changed = False
+            for relpath, qual, fsum in index.functions():
+                key = (relpath, qual)
+                if taints_ret.get(key):
+                    continue
+                if self._interp(index, relpath, qual, fsum, taints_ret, None):
+                    taints_ret[key] = True
+                    changed = True
+            if not changed:
+                break
+        for relpath, qual, fsum in index.functions():
+            self._interp(index, relpath, qual, fsum, taints_ret, run)
+
+    def _interp(self, index, relpath, qual, fsum, taints_ret, run):
+        mod = index.modules[relpath]
+        donators = dict(mod.get("jit_donators", {}))
+        var_types = fsum.get("var_types", {})
+        cls = fsum.get("class")
+        taint = {}    # var -> (source description, source line)
+        donated = {}  # var -> (callee, donation line)
+        returns = False
+
+        def resolve(callee):
+            return index.resolve_call(relpath, cls, callee, var_types)
+
+        def mark_donation(callee, argvars, line):
+            if callee not in donators:
+                return
+            positions = donators[callee]
+            if positions is None:
+                positions = range(len(argvars))
+            for i in positions:
+                if 0 <= i < len(argvars) and argvars[i] is not None:
+                    donated[argvars[i]] = (callee, line)
+
+        def sink(var, line, desc):
+            if run is None or var not in taint:
+                return False
+            src, src_line = taint[var]
+            run.report(
+                self,
+                relpath,
+                line,
+                "`{}` in {}() aliases device memory ({}, line {}) and is {} — "
+                "device-derived values can be read-only views (jax's cached "
+                "sharded assembly); copy via np.array(..., copy=True) or check "
+                ".flags first".format(var, qual, src, src_line, desc),
+            )
+            return True
+
+        for ev in fsum["events"]:
+            tag = ev[0]
+            if tag == "use":
+                _, var, line = ev
+                if var in donated and line > donated[var][1]:
+                    callee, don_line = donated.pop(var)
+                    if run is not None:
+                        run.report(
+                            self,
+                            relpath,
+                            line,
+                            "`{}` in {}() is read after being donated to "
+                            "{}() (line {}) — donated buffers are invalidated "
+                            "by XLA; rebind the result (`{} = {}(...)`) or "
+                            "drop the donation".format(
+                                var, qual, callee, don_line, var, callee
+                            ),
+                        )
+            elif tag == "san":
+                taint.pop(ev[1], None)
+            elif tag == "call":
+                _, callee, argvars, line = ev
+                mark_donation(callee, argvars, line)
+            elif tag == "jitdon":
+                _, var, positions, _line = ev
+                donators[var] = positions
+                taint.pop(var, None)
+                donated.pop(var, None)
+            elif tag == "asn":
+                _, var, kind, payload, line = ev
+                donated.pop(var, None)
+                if kind == "src":
+                    taint[var] = (payload, line)
+                elif kind == "alias":
+                    if payload in taint:
+                        taint[var] = taint[payload]
+                    else:
+                        taint.pop(var, None)
+                elif kind == "aliasany":
+                    hit = next((p for p in payload if p in taint), None)
+                    if hit is not None:
+                        taint[var] = taint[hit]
+                    else:
+                        taint.pop(var, None)
+                elif kind == "call":
+                    # donation already marked by the preceding "call" event
+                    callee, argvars = payload
+                    target = resolve(callee)
+                    if target is not None and taints_ret.get(target):
+                        taint[var] = ("result of {}()".format(callee), line)
+                    else:
+                        taint.pop(var, None)
+                else:
+                    taint.pop(var, None)
+            elif tag == "wsink":
+                _, var, line, desc = ev
+                if sink(var, line, desc):
+                    taint.pop(var, None)
+            elif tag == "psink":
+                _, var, line, desc = ev
+                if sink(var, line, desc):
+                    taint.pop(var, None)
+            elif tag == "ret":
+                if ev[1] in taint:
+                    returns = True
+            elif tag == "retsrc":
+                returns = True
+            elif tag == "retcall":
+                _, callee, _argvars, _line = ev
+                target = resolve(callee)
+                if target is not None and taints_ret.get(target):
+                    returns = True
+        return returns

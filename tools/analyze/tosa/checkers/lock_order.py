@@ -1,0 +1,279 @@
+"""lock-order: global lock-acquisition order graph; cycles are deadlocks.
+
+Phase 2 of the RacerD-style compositional story ``lock-discipline``
+started: phase 1 summarized, per function, which locks are acquired,
+which are acquired *while another is held* (nested ``with``), and which
+calls happen under a held lock. This rule composes those summaries
+project-wide:
+
+1. A transitive **eventually-acquires** set per function (fixpoint over
+   the call graph), so ``with A: self._helper()`` contributes an
+   ``A -> B`` edge when the helper takes ``B`` — even across modules.
+2. A global digraph over resolved lock identities
+   (``module:Class.attr`` / ``module:name``); every strongly-connected
+   component with two or more locks is a potential deadlock, reported
+   once with a concrete cycle.
+3. The **bounded-queue handoff** pattern: a blocking ``self.q.put()`` on
+   a bounded queue while holding a lock that the queue's consumer thread
+   also acquires deadlocks when the queue is full (producer waits for
+   space holding L; consumer waits for L before draining). Likewise
+   ``thread.join()`` (no timeout) under a lock the joined thread's
+   closure acquires.
+
+Only *resolved* lock identities contribute edges — an unresolvable
+expression produces no edge rather than a speculative one, keeping the
+rule quiet by under-approximation.
+"""
+
+from .. import core
+
+
+class LockOrderChecker(core.Checker):
+    rule = "lock-order"
+    description = (
+        "lock acquisition order must be acyclic project-wide; no blocking "
+        "bounded-queue puts or joins while holding the consumer's lock"
+    )
+    interests = ()
+    project = True
+
+    def check_project(self, index, run):
+        acquires = self._eventually_acquires(index)
+        edges = self._edges(index, acquires)
+        self._report_cycles(run, edges)
+        self._queue_patterns(index, run)
+
+    # -- acquisition-order graph --------------------------------------------
+
+    def _eventually_acquires(self, index):
+        """(relpath, qual) -> set of lock ids transitively acquired."""
+        acq = {}
+        for relpath, qual, fsum in index.functions():
+            acq[(relpath, qual)] = {lid for lid, _ in fsum.get("acquires", ())}
+        for _ in range(8):  # fixpoint; call-graph depth bound
+            changed = False
+            for relpath, qual, fsum in index.functions():
+                key = (relpath, qual)
+                cur = acq[key]
+                for callee in fsum.get("calls", ()):
+                    target = index.resolve_call(
+                        relpath, fsum.get("class"), callee, fsum.get("var_types")
+                    )
+                    if target is not None and target in acq:
+                        extra = acq[target] - cur
+                        if extra:
+                            cur |= extra
+                            changed = True
+            if not changed:
+                break
+        return acq
+
+    def _edges(self, index, acquires):
+        """(held, acquired) -> earliest (relpath, line) witness."""
+        edges = {}
+
+        def add(a, b, relpath, line):
+            if a == b:
+                return  # reentrant acquisition is lock-discipline's business
+            site = (relpath, line)
+            if (a, b) not in edges or site < edges[(a, b)]:
+                edges[(a, b)] = site
+
+        for relpath, qual, fsum in index.functions():
+            for held, acquired, line in fsum.get("edges", ()):
+                add(held, acquired, relpath, line)
+            for held, callee, line in fsum.get("calls_under", ()):
+                target = index.resolve_call(
+                    relpath, fsum.get("class"), callee, fsum.get("var_types")
+                )
+                if target is None:
+                    continue
+                for lid in sorted(acquires.get(target, ())):
+                    add(held, lid, relpath, line)
+        return edges
+
+    def _report_cycles(self, run, edges):
+        adj = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        for scc in self._sccs(adj):
+            if len(scc) < 2:
+                continue
+            cycle = self._concrete_cycle(adj, scc)
+            if len(cycle) < 2:
+                continue
+            witness = []
+            for i, lock in enumerate(cycle):
+                nxt = cycle[(i + 1) % len(cycle)]
+                path, line = edges[(lock, nxt)]
+                witness.append(
+                    "{} held while acquiring {} at {}:{}".format(lock, nxt, path, line)
+                )
+            anchor = min(
+                edges[(cycle[i], cycle[(i + 1) % len(cycle)])] for i in range(len(cycle))
+            )
+            run.report(
+                self,
+                anchor[0],
+                anchor[1],
+                "lock acquisition cycle (potential deadlock): {} -> {} ({})".format(
+                    " -> ".join(cycle), cycle[0], "; ".join(witness)
+                ),
+            )
+
+    def _sccs(self, adj):
+        """Tarjan's algorithm, iterative, deterministic node order."""
+        order = sorted(adj)
+        idx, low, on_stack = {}, {}, set()
+        stack, out = [], []
+        counter = [0]
+
+        def strongconnect(v):
+            work = [(v, iter(sorted(adj[v])))]
+            idx[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in idx:
+                        idx[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(adj[w]))))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], idx[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == idx[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    out.append(sorted(scc))
+
+        for v in order:
+            if v not in idx:
+                strongconnect(v)
+        return out
+
+    def _concrete_cycle(self, adj, scc):
+        """A shortest concrete cycle through the SCC's smallest lock; every
+        consecutive pair (including the wrap-around) is a real edge."""
+        members = set(scc)
+        start = scc[0]
+        for first in sorted(adj[start] & members):
+            if first == start:
+                continue
+            prev = {first: None}
+            frontier = [first]
+            while frontier and start not in prev:
+                nxt = []
+                for node in frontier:
+                    for w in sorted(adj[node]):
+                        if (w in members or w == start) and w not in prev:
+                            prev[w] = node
+                            nxt.append(w)
+                frontier = nxt
+            if start in prev:
+                path = []
+                node = prev[start]
+                while node is not None:
+                    path.append(node)
+                    node = prev[node]
+                return [start] + list(reversed(path))
+        return [start]  # unreachable for a true SCC; keeps the rule total
+
+    # -- bounded-queue / join handoff patterns ------------------------------
+
+    def _queue_patterns(self, index, run):
+        for relpath in sorted(index.modules):
+            mod = index.modules[relpath]
+            for cname in sorted(mod.get("classes", ())):
+                cls = mod["classes"][cname]
+                consumers = self._consumers(mod, cname, cls)
+                if not consumers:
+                    continue
+                for qual in sorted(mod["functions"]):
+                    fsum = mod["functions"][qual]
+                    if fsum.get("class") != cname:
+                        continue
+                    for held, qref, line, blocking in fsum.get("puts_under", ()):
+                        if not blocking:
+                            continue
+                        attr = qref.split(".", 1)[1]
+                        if not cls["queue_attrs"].get(attr, {}).get("bounded"):
+                            continue
+                        for target, (locks, gets) in consumers:
+                            if qref in gets and held in locks:
+                                run.report(
+                                    self,
+                                    relpath,
+                                    line,
+                                    "blocking put on bounded queue `self.{}` "
+                                    "while holding {} — the consumer thread "
+                                    "(`self.{}`) takes the same lock before "
+                                    "draining, so a full queue deadlocks; use "
+                                    "put(timeout=...) or release the lock "
+                                    "first".format(attr, held, target),
+                                )
+                                break
+                    for held, line, has_timeout in fsum.get("joins_under", ()):
+                        if has_timeout:
+                            continue
+                        for target, (locks, _gets) in consumers:
+                            if held in locks:
+                                run.report(
+                                    self,
+                                    relpath,
+                                    line,
+                                    "join() without a timeout while holding {} "
+                                    "— the joined thread (`self.{}`) acquires "
+                                    "the same lock, so this can deadlock; join "
+                                    "outside the lock or pass a timeout".format(
+                                        held, target
+                                    ),
+                                )
+                                break
+
+    def _consumers(self, mod, cname, cls):
+        """[(spawn target, (locks acquired in its closure, queues drained))]
+        — the closure is the transitive self-call set within the class."""
+        out = []
+        for target in cls.get("spawn_targets", ()):
+            seen, stack = set(), [target]
+            while stack:
+                m = stack.pop()
+                if m in seen:
+                    continue
+                seen.add(m)
+                for qual, fsum in mod["functions"].items():
+                    if qual == "{}.{}".format(cname, m) or qual.startswith(
+                        "{}.{}.<".format(cname, m)
+                    ):
+                        for callee in fsum.get("calls", ()):
+                            if callee.startswith("self.") and callee.count(".") == 1:
+                                stack.append(callee[5:])
+            locks, gets = set(), set()
+            for m in seen:
+                for qual, fsum in mod["functions"].items():
+                    if qual == "{}.{}".format(cname, m) or qual.startswith(
+                        "{}.{}.<".format(cname, m)
+                    ):
+                        locks.update(lid for lid, _ in fsum.get("acquires", ()))
+                        gets.update(fsum.get("queue_gets", ()))
+            out.append((target, (locks, gets)))
+        return out

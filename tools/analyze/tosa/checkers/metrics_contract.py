@@ -1,0 +1,168 @@
+"""metrics-contract: every metric is named, registered, merged, documented.
+
+Four invariants over the phase-1 index's metric registration facts
+(mirroring ``chaos-obs-coverage``'s two-direction drift discipline):
+
+1. **Literal names** — ``obs.counter("...")`` / ``.gauge`` / ``.histogram``
+   must use a string-literal name so the inventory stays auditable.
+   The ``obs`` and ``chaos`` packages themselves are exempt (they build
+   names like ``chaos_fault_{site}_total`` / ``{span}_seconds`` by
+   design).
+2. **Naming conventions** — counters end in ``_total``; gauges and
+   histograms must NOT (Prometheus conventions, per
+   docs/architecture.md).
+3. **Reachability** — a function-local private ``Registry()`` whose
+   metrics are never merged (``accumulate_to_channel`` /
+   ``publish_to_channel`` / ``SnapshotPublisher``) and never escapes the
+   function is invisible to ``TFCluster.metrics()``: dead telemetry.
+4. **Docs drift, both directions** — every registered metric appears in
+   the "Metrics inventory" table of ``docs/architecture.md`` with the
+   right kind, and every documented row is registered somewhere. Rows
+   whose name contains ``{`` document dynamic families and are matched
+   loosely; rows containing ``<`` are placeholders and ignored.
+
+The docs half is skipped when the scan has no docs text (fixture runs
+can inject one through the index's ``docs`` mapping).
+"""
+
+import re
+
+from .. import core
+
+DOC_RELPATH = "docs/architecture.md"
+
+#: a Metrics-inventory row: | `name` | kind | description |
+ROW_RE = re.compile(
+    r"^\s*\|\s*``?(?P<name>[A-Za-z0-9_{}]+)``?\s*\|\s*(?P<kind>counter|gauge|histogram)\b"
+)
+
+#: packages allowed to register dynamically-named metrics
+DYNAMIC_NAME_EXEMPT = (
+    "tensorflowonspark_tpu/obs/",
+    "tensorflowonspark_tpu/chaos/",
+)
+
+
+class MetricsContractChecker(core.Checker):
+    rule = "metrics-contract"
+    description = (
+        "metrics must use literal conforming names, reach the cluster "
+        "merge, and match the docs/architecture.md Metrics inventory"
+    )
+    interests = ()
+    project = True
+
+    def check_project(self, index, run):
+        registered = {}  # name -> (kind, relpath, line)
+        for relpath, qual, fsum in index.functions():
+            regs = fsum.get("metric_regs", ())
+            for kind, name, line, recv in regs:
+                if recv == "other":
+                    continue
+                if name is None:
+                    if not relpath.startswith(DYNAMIC_NAME_EXEMPT):
+                        run.report(
+                            self,
+                            relpath,
+                            line,
+                            "metric registered with a non-literal name in {}() — "
+                            "names must be string literals so the Metrics "
+                            "inventory stays auditable (dynamic families belong "
+                            "in obs/ or chaos/)".format(qual),
+                        )
+                    continue
+                if kind == "counter" and not name.endswith("_total"):
+                    run.report(
+                        self,
+                        relpath,
+                        line,
+                        "counter `{}` does not end in `_total` — counters are "
+                        "monotonic and follow the Prometheus naming "
+                        "convention".format(name),
+                    )
+                elif kind != "counter" and name.endswith("_total"):
+                    run.report(
+                        self,
+                        relpath,
+                        line,
+                        "{} `{}` ends in `_total`, which is reserved for "
+                        "counters — rename it or register a counter".format(kind, name),
+                    )
+                prev = registered.get(name)
+                if prev is not None and prev[0] != kind:
+                    run.report(
+                        self,
+                        relpath,
+                        line,
+                        "metric `{}` is registered here as a {} but as a {} at "
+                        "{}:{} — one name, one kind".format(
+                            name, kind, prev[0], prev[1], prev[2]
+                        ),
+                    )
+                registered.setdefault(name, (kind, relpath, line))
+            # 3. private Registry reachability
+            published = set(fsum.get("registry_published", ()))
+            escapes = set(fsum.get("registry_escapes", ()))
+            for var, line in fsum.get("registry_vars", ()):
+                if var in published or var in escapes:
+                    continue
+                if any(r[3] == "var:" + var for r in regs):
+                    run.report(
+                        self,
+                        relpath,
+                        line,
+                        "private Registry `{}` in {}() records metrics but is "
+                        "never merged (accumulate_to_channel / "
+                        "publish_to_channel / SnapshotPublisher) — its metrics "
+                        "can't reach TFCluster.metrics()".format(var, qual),
+                    )
+        self._check_docs(index, run, registered)
+
+    def _check_docs(self, index, run, registered):
+        doc = index.docs.get(DOC_RELPATH)
+        if doc is None:
+            return  # fixture runs without docs skip the drift half
+        documented = {}   # literal name -> (kind, line)
+        families = []     # (regex, kind, line) for `{...}` rows
+        for lineno, text in enumerate(doc.splitlines(), start=1):
+            m = ROW_RE.match(text)
+            if not m or "<" in m.group("name"):
+                continue
+            name, kind = m.group("name"), m.group("kind")
+            if "{" in name:
+                pat = re.escape(name)
+                pat = re.sub(r"\\{[A-Za-z0-9_\\]*\\}", "[a-z0-9_]+", pat)
+                families.append((re.compile("^" + pat + "$"), kind, lineno))
+            else:
+                documented.setdefault(name, (kind, lineno))
+        for name in sorted(registered):
+            kind, relpath, line = registered[name]
+            if name in documented:
+                doc_kind, doc_line = documented[name]
+                if doc_kind != kind:
+                    run.report(
+                        self,
+                        DOC_RELPATH,
+                        doc_line,
+                        "metric `{}` is documented as a {} but registered as a "
+                        "{} at {}:{}".format(name, doc_kind, kind, relpath, line),
+                    )
+            elif not any(pat.match(name) for pat, _k, _l in families):
+                run.report(
+                    self,
+                    relpath,
+                    line,
+                    "metric `{}` ({}) is registered here but missing from the "
+                    "Metrics inventory in {} — add a row so dashboards and "
+                    "operators can find it".format(name, kind, DOC_RELPATH),
+                )
+        for name in sorted(set(documented) - set(registered)):
+            kind, doc_line = documented[name]
+            run.report(
+                self,
+                DOC_RELPATH,
+                doc_line,
+                "metric `{}` is documented in the Metrics inventory but never "
+                "registered in the scanned code — stale row or missing "
+                "instrumentation".format(name),
+            )

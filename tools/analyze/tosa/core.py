@@ -67,6 +67,12 @@ class Finding:
             d["baselined"] = True
         return d
 
+    @classmethod
+    def from_dict(cls, d):
+        f = cls(d["rule"], d["path"], d["line"], d["col"], d["message"])
+        f.suppressed = d.get("suppressed")
+        return f
+
     def __repr__(self):
         return "{}:{}: [{}] {}".format(self.path, self.line, self.rule, self.message)
 
@@ -96,6 +102,11 @@ class Checker:
     def end_run(self, run):
         """Called once after every file; cross-file findings go through
         ``run.report(...)``."""
+
+    # Project-aware checkers additionally define
+    # ``check_project(index, run)``; when the engine has built a phase-1
+    # index it calls that INSTEAD of ``end_run`` (the index carries the
+    # cross-file facts even for files whose walk was a cache hit).
 
 
 class FileContext:
@@ -141,10 +152,11 @@ class FileContext:
 
 
 class RunContext:
-    """Cross-file accumulator passed to ``end_run``."""
+    """Cross-file accumulator passed to ``end_run``/``check_project``."""
 
     def __init__(self):
         self.findings = []
+        self.suppressions = {}  # relpath -> suppression map (block-expanded)
 
     def report(self, checker, relpath, line, message):
         self.findings.append(Finding(checker.rule, relpath, line, 0, message))
@@ -186,6 +198,38 @@ def _suppressions(source):
     return out
 
 
+#: statements whose header suppression covers the whole block (flow rules
+#: anchor findings at arbitrary lines inside the block)
+_BLOCK_NODES = (ast.With, ast.AsyncWith, ast.For, ast.AsyncFor, ast.While)
+
+
+def _suppression_map(source, tree=None):
+    """Line-exact suppressions, plus block scoping: a suppression comment
+    on a ``with``/``for``/``while`` header covers every line of the block."""
+    out = _suppressions(source)
+    if tree is None or not out:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, _BLOCK_NODES):
+            continue
+        header_end = node.body[0].lineno - 1 if node.body else node.lineno
+        entry = None
+        for ln in range(node.lineno, header_end + 1):
+            if ln in out:
+                entry = out[ln]
+                break
+        if entry is None:
+            continue
+        end = getattr(node, "end_lineno", None) or node.lineno
+        for ln in range(node.lineno, end + 1):
+            existing = out.get(ln)
+            if existing is None:
+                out[ln] = entry
+            elif existing is not entry:
+                out[ln] = (existing[0] | entry[0], existing[1] or entry[1])
+    return out
+
+
 def _walk(tree, checkers, ctx):
     """Single depth-first walk with an explicit ancestor stack."""
 
@@ -222,31 +266,98 @@ def analyze_files(paths, checkers, root=None):
     """Run ``checkers`` over ``paths`` (one parse + one walk per file).
     Returns the full finding list — suppressed entries annotated, nothing
     dropped (the CLI layer decides what gates)."""
+    return analyze_project(paths, checkers, root=root)
+
+
+def analyze_project(paths, checkers, root=None, cache_path=None, report_only=None):
+    """Two-phase analysis: build the project index (phase 1) while walking
+    per-file checkers, then run project-wide rules against it (phase 2).
+
+    ``cache_path`` enables the content-hash index cache: unchanged files
+    reuse their cached summary, walk findings and suppression map instead
+    of being re-parsed. ``report_only`` (a set of relpaths) restricts
+    *per-file* findings to those files — the ``--changed`` / pre-commit
+    mode — while project-wide rules still see the whole index.
+    """
+    from . import index as _index
+
     root = root or os.getcwd()
     findings = []
     run = RunContext()
-    per_file_suppressions = {}
+    proj = _index.ProjectIndex(root=root)
+    cache = _index.load_cache(cache_path, [c.rule for c in checkers]) if cache_path else None
     for path in paths:
         relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        reported = report_only is None or relpath in report_only
         try:
-            with open(path, encoding="utf-8") as f:
-                source = f.read()
+            with open(path, "rb") as f:
+                data = f.read()
         except OSError as e:
-            f_err = Finding("parse-error", relpath, 1, 0, "unreadable: {}".format(e))
-            findings.append(f_err)
+            if reported:
+                findings.append(
+                    Finding("parse-error", relpath, 1, 0, "unreadable: {}".format(e))
+                )
             continue
-        per_file_suppressions[relpath] = _suppressions(source)
-        findings.extend(analyze_source(source, relpath, checkers, run=run, path=path))
+        digest = _index.content_hash(data)
+        if cache is not None:
+            entry = cache.get(relpath, digest)
+            if entry is not None:
+                proj.add_summary(relpath, entry["summary"])
+                run.suppressions[relpath] = _decode_suppressions(entry["suppressions"])
+                if reported:
+                    findings.extend(Finding.from_dict(d) for d in entry["findings"])
+                continue
+        try:
+            source = data.decode("utf-8")
+        except UnicodeDecodeError as e:
+            if reported:
+                findings.append(
+                    Finding("parse-error", relpath, 1, 0, "undecodable: {}".format(e))
+                )
+            continue
+        file_findings = analyze_source(
+            source, relpath, checkers, run=run, path=path, project=proj
+        )
+        if cache is not None:
+            cache.put(
+                relpath,
+                digest,
+                proj.modules.get(relpath),
+                [f.to_dict() for f in file_findings],
+                _encode_suppressions(run.suppressions.get(relpath, {})),
+            )
+        if reported:
+            findings.extend(file_findings)
+    proj.load_docs()
     for checker in checkers:
-        checker.end_run(run)
+        check_project = getattr(checker, "check_project", None)
+        if check_project is not None:
+            check_project(proj, run)
+        else:
+            checker.end_run(run)
     for f in run.findings:  # cross-file findings honor their anchor file's
-        _apply_suppressions([f], per_file_suppressions.get(f.path, {}))
+        _apply_suppressions([f], run.suppressions.get(f.path, {}))
     findings.extend(run.findings)
+    if cache is not None:
+        cache.save()
     return findings
 
 
-def analyze_source(source, relpath, checkers, run=None, path=None):
-    """Analyze one already-read source blob; the test-fixture entry point."""
+def _encode_suppressions(supp):
+    return {str(ln): [sorted(rules), reason] for ln, (rules, reason) in supp.items()}
+
+
+def _decode_suppressions(encoded):
+    return {int(ln): (set(rules), reason) for ln, (rules, reason) in encoded.items()}
+
+
+def analyze_source(source, relpath, checkers, run=None, path=None, project=None):
+    """Analyze one already-read source blob; the test-fixture entry point.
+
+    With ``project`` (a ``ProjectIndex``), the file's phase-1 summary is
+    added to it and the block-expanded suppression map is recorded on
+    ``run`` so project-wide findings anchored here can be suppressed.
+    """
     if run is None:
         run = RunContext()
         finish = True
@@ -256,17 +367,24 @@ def analyze_source(source, relpath, checkers, run=None, path=None):
         tree = ast.parse(source, filename=relpath)
     except SyntaxError as e:
         return [Finding("parse-error", relpath, e.lineno or 1, 0, "unparseable: {}".format(e.msg))]
+    if project is not None:
+        from . import index as _index
+
+        project.add_summary(relpath, _index.summarize(tree, source, relpath))
+    suppressions = _suppression_map(source, tree)
+    if run is not None:
+        run.suppressions[relpath] = suppressions
     ctx = FileContext(path or relpath, relpath, source, tree)
     for checker in checkers:
         checker.begin_file(ctx)
     _walk(tree, checkers, ctx)
     for checker in checkers:
         checker.end_file(ctx)
-    findings = _apply_suppressions(ctx.findings, _suppressions(source))
+    findings = _apply_suppressions(ctx.findings, suppressions)
     if finish:
         for checker in checkers:
             checker.end_run(run)
-        findings.extend(_apply_suppressions(run.findings, _suppressions(source)))
+        findings.extend(_apply_suppressions(run.findings, suppressions))
     return findings
 
 

@@ -1,0 +1,83 @@
+"""SARIF 2.1.0 serialization of a tosa run.
+
+SARIF (Static Analysis Results Interchange Format) is what code-scanning
+UIs ingest (GitHub code scanning, VS Code SARIF viewer). One run, one
+driver (``tosa``), one rule entry per registered checker, one result per
+finding. Inline-suppressed and baselined findings are emitted with a
+``suppressions`` entry so viewers show them struck-through instead of
+dropping them — the same "report everything, gate on the remainder"
+contract as the JSON report.
+"""
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemas/sarif-schema-2.1.0.json"
+)
+
+
+def to_sarif(findings, checkers, version):
+    """Build the SARIF 2.1.0 document (a plain dict) for one run."""
+    rules = [
+        {
+            "id": c.rule,
+            "shortDescription": {"text": c.description or c.rule},
+            "defaultConfiguration": {"level": "error"},
+        }
+        for c in sorted(checkers, key=lambda c: c.rule)
+    ]
+    rule_index = {r["id"]: i for i, r in enumerate(rules)}
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {
+                            "startLine": max(f.line, 1),
+                            "startColumn": max(f.col + 1, 1),
+                        },
+                    }
+                }
+            ],
+            "partialFingerprints": {"tosa/v1": f.fingerprint},
+        }
+        if f.rule in rule_index:
+            result["ruleIndex"] = rule_index[f.rule]
+        suppressions = []
+        if f.suppressed is not None:
+            suppressions.append(
+                {"kind": "inSource", "justification": f.suppressed}
+            )
+        if f.baselined:
+            suppressions.append(
+                {"kind": "external", "justification": "baselined finding"}
+            )
+        if suppressions:
+            result["suppressions"] = suppressions
+        results.append(result)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tosa",
+                        "informationUri": "docs/analysis.md",
+                        "version": version,
+                        "rules": rules,
+                    }
+                },
+                "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+                "results": results,
+            }
+        ],
+    }
