@@ -62,6 +62,7 @@ MODULES = [
     "tensorflowonspark_tpu.train.metrics",
     "tensorflowonspark_tpu.data.loader",
     "tensorflowonspark_tpu.data.autotune",
+    "tensorflowonspark_tpu.data.decode_plane",
     "tensorflowonspark_tpu.data.imagenet",
     "tensorflowonspark_tpu.data.cifar",
     "tensorflowonspark_tpu.models.mnist",

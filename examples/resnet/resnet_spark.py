@@ -104,10 +104,10 @@ def main_fun(args, ctx):
     steps_per_loop = max(int(getattr(args, "steps_per_loop", 1) or 1), 1)
     if steps_per_loop > 1:
         # K steps fused into one lax.scan dispatch; transfers overlap compute.
-        # The synthetic path re-feeds one device batch, so only donate state.
+        # donate=True is state-only in both modes, safe for the synthetic
+        # path's re-fed device batch too.
         loop = strategy.compile_train_loop(
-            loss_fn, optimizer, steps_per_loop, mutable=True,
-            donate=True if use_real else "state",
+            loss_fn, optimizer, steps_per_loop, mutable=True, donate=True,
         )
     step = strategy.compile_train_step(loss_fn, optimizer, mutable=True)
 

@@ -94,9 +94,12 @@ else
 fi
 
 if [[ "$PERF_SMOKE" == "1" ]]; then
-  # covers the IO/parse overlap proof AND the autotune adaptation leg
-  # (tests/test_autotune.py::TestChaosDeviceLink) — both sleep-staged,
-  # no real accelerator or absolute-throughput assertion involved
+  # covers the IO/parse overlap proof, the autotune adaptation leg
+  # (tests/test_autotune.py::TestChaosDeviceLink) — both sleep-staged, no
+  # real accelerator or absolute-throughput assertion involved — and the
+  # decode-plane GIL-release leg (tests/test_decode_plane.py::TestGilRelease:
+  # process workers must beat one thread on a CPU-bound parse; skips
+  # cleanly on hosts with fewer than 4 cores where the race is meaningless)
   exec python -m pytest tests/ -q -m perf_smoke ${EXTRA[@]+"${EXTRA[@]}"}
 fi
 
@@ -108,14 +111,17 @@ if [[ "$CHAOS" == "1" ]]; then
   # cluster metrics.
   echo "chaos leg: node.kill recovery-ladder run"
   python -m pytest tests/test_elastic.py -q -m "chaos and slow"
-  # Benign (delay-only) sites at low probability: the suite's assertions
-  # must keep passing — chaos here perturbs timing, not outcomes. Error
+  # Benign-in-outcome sites at low probability: the suite's assertions
+  # must keep passing — most sites only perturb timing; data.decode_kill
+  # SIGKILLs a decode worker, which the plane's respawn-and-release
+  # protocol must absorb without losing or duplicating a row. Error
   # faults get exercised deterministically by tests/test_chaos_*.py.
   export TOS_CHAOS_PLAN='{"seed": 2024, "sites": {
     "feed.stall":           {"probability": 0.02, "max_count": null, "delay_s": 0.01},
     "feed.slow_consumer":   {"probability": 0.02, "max_count": null, "delay_s": 0.01},
     "data.producer_delay":  {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "data.shard_read":      {"probability": 0.05, "max_count": null, "delay_s": 0.01},
+    "data.decode_kill":     {"probability": 0.05, "max_count": null},
     "serving.latency":      {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "reservation.slow_accept": {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "ckpt.snapshot_stall":  {"probability": 0.05, "max_count": null, "delay_s": 0.01},

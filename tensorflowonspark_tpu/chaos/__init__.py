@@ -51,6 +51,11 @@ site                            effect at the injection point
 ``data.shard_read``             read-ahead shard open sleeps (``delay_s``) or
                                 raises ``IOError`` (``error: true``); errors
                                 are retried under ``SHARD_READ_RETRY``
+``data.decode_kill``            decode plane SIGKILLs one of its own worker
+                                processes mid-round — the lease protocol
+                                must re-decode the orphaned slots on the
+                                respawned pool without losing or
+                                duplicating a row
 ``data.device_link``            autotuned feed sleeps ``delay_s`` inside the
                                 timed region of every host->device transfer
                                 (probes and windows), so injected latency

@@ -7,9 +7,12 @@ cifar_preprocessing.py:42 parse_record); here it is a framework subpackage:
 TFRecord shards are streamed in chunks through the native C++ reader
 (:mod:`tensorflowonspark_tpu.native_io`) with shard read-ahead overlapping
 IO against the parse stage, records re-ordered by a bounded shuffle buffer,
-images decoded/augmented with PIL+numpy on a thread pool straight into
-preallocated batch buffers, and fixed-shape batches double-buffered onto the
-device mesh — static shapes and steady feed keep XLA and the MXU busy.
+images decoded/augmented with PIL+numpy on a thread pool — or, with
+``decode_workers > 0``, GIL-free in the :mod:`~tensorflowonspark_tpu.data.
+decode_plane` worker processes writing into shared-memory slabs — straight
+into preallocated batch buffers, and fixed-shape batches double-buffered
+onto the device mesh — static shapes and steady feed keep XLA and the MXU
+busy.
 The device placement itself is adaptive: :mod:`~tensorflowonspark_tpu.data.
 autotune` measures the host→device link online (fixed cost + bandwidth) and
 sizes the packed transfer window K to amortize the link's per-transfer
@@ -29,5 +32,9 @@ from tensorflowonspark_tpu.data.autotune import (  # noqa: F401
     FeedAutotuner,
     LinkEstimator,
     autotuned_prefetch,
+)
+from tensorflowonspark_tpu.data.decode_plane import (  # noqa: F401
+    DecodeAutotuner,
+    DecodePlane,
 )
 from tensorflowonspark_tpu.data import cifar, imagenet  # noqa: F401

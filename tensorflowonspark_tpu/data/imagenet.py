@@ -113,6 +113,14 @@ def make_parse_fn(is_training, image_size=IMAGE_SIZE, label_offset=0, seed=0, ra
     matter how the thread pool schedules the parses. ``raw_uint8=True``
     keeps images uint8 and un-normalized for the slim feed path (pair with
     :func:`device_normalize` on device).
+
+    Decode-plane contract: the returned closure must work after a fork —
+    it captures only plain values (no locks, threads or open handles) and
+    lives at module level, so ``ImagePipeline(decode_workers=N)`` can run
+    it inside worker processes. Keep custom ``parse_fn`` replacements to
+    the same shape: fork-inheritable state only, deterministic per record
+    bytes (the record-keyed rng above), since a chaos-killed worker's slot
+    may be decoded twice and both decodes must write identical pixels.
     """
     import zlib
 

@@ -18,7 +18,12 @@ three-stage pipeline so IO, decode and the device never wait on each other:
 3. **Zero-copy batch assembly** — parse workers decode records straight
    into slots of a preallocated ``[B,H,W,C]`` batch buffer (no per-batch
    ``np.stack`` copy). With ``recycle_buffers=True`` the buffers circulate
-   through a fixed pool instead of being reallocated per batch.
+   through a fixed pool instead of being reallocated per batch. With
+   ``decode_workers > 0`` the parse stage moves off the GIL entirely: a
+   :class:`~tensorflowonspark_tpu.data.decode_plane.DecodePlane` of worker
+   *processes* decodes records straight into shared-memory batch slabs and
+   the pool becomes a cross-process slab free list — same slot-assignment
+   algorithm, same byte-identical stream, different place the decode runs.
 
 Stall accounting: the producer and consumer publish
 ``data_producer_read_seconds_total`` / ``data_producer_parse_seconds_total``
@@ -30,6 +35,7 @@ the consumer never waits).
 """
 
 import collections
+import contextlib
 import logging
 import os
 import queue
@@ -39,6 +45,7 @@ import time
 import numpy as np
 
 from tensorflowonspark_tpu import chaos, obs, resilience
+from tensorflowonspark_tpu.data import decode_plane
 
 logger = logging.getLogger(__name__)
 
@@ -218,6 +225,16 @@ class ImagePipeline:
       pool instead of being reallocated. The yielded batch is then only
       valid until the *next* ``next()``; leave False (default) if batches
       are retained (e.g. ``list(pipe)``).
+    - ``decode_workers`` — run the parse stage in worker *processes*
+      decoding straight into shared-memory slabs (GIL-free; see
+      :mod:`~tensorflowonspark_tpu.data.decode_plane`). Default env
+      ``TOS_DECODE_WORKERS`` or 0 = today's in-process thread pool;
+      ``"auto"`` self-sizes from the parse/wait stall counters. Requires a
+      fork start method, an importable/fork-inheritable ``parse_fn``
+      (module-level factories like ``imagenet.make_parse_fn`` qualify) and
+      ``multiprocessing.shared_memory`` — otherwise the thread pool is used
+      with a warning. The delivered batch stream is byte-identical across
+      thread and process modes.
 
     ``max_bad_records`` is the poisoned-input budget: records whose
     ``parse_fn`` raises are skipped (counted in
@@ -247,6 +264,7 @@ class ImagePipeline:
         shuffle_buffer=4096,
         cache=None,
         recycle_buffers=False,
+        decode_workers=None,
     ):
         if not files:
             raise ValueError("no input files")
@@ -279,6 +297,7 @@ class ImagePipeline:
             )
         self.cache = cache
         self.recycle_buffers = bool(recycle_buffers)
+        self.decode_workers = decode_workers
         # raw cache: path -> [record bytes], marked complete only after a
         # full clean read; decoded cache: (path, record index) -> _Decoded
         self._raw_cache = {}
@@ -479,6 +498,28 @@ class ImagePipeline:
             "(starvation: the input pipeline is the bottleneck)",
         )
 
+        # the decode plane forks its workers HERE, before any pipeline
+        # thread exists (the reader/parse executors spawn lazily, on first
+        # submit) — fork-with-threads is the one mp lifecycle hazard
+        plane = None
+        workers, auto = decode_plane.resolve_workers(self.decode_workers)
+        if workers > 0:
+            if decode_plane.available():
+                tuner = (
+                    decode_plane.DecodeAutotuner(
+                        max_workers=max(workers, os.cpu_count() or 1)
+                    )
+                    if auto
+                    else None
+                )
+                plane = decode_plane.DecodePlane(self.parse_fn, workers, autotuner=tuner)
+            else:
+                logger.warning(
+                    "decode_workers=%s requested but fork/shared_memory is "
+                    "unavailable here; falling back to the thread parse pool",
+                    workers,
+                )
+
         reader_pool = (
             ThreadPoolExecutor(self.readahead, thread_name_prefix="tos-data-reader")
             if self.readahead > 0
@@ -495,29 +536,42 @@ class ImagePipeline:
                 except queue.Full:
                     continue
 
+        def _new_pair():
+            # process mode mints a shared-memory slab (the view circulates
+            # exactly like a plain buffer pair); thread mode a heap buffer
+            if plane is not None:
+                return plane.new_slab(B, img_meta["shape"], img_meta["dtype"])
+            return (
+                np.empty((B,) + img_meta["shape"], img_meta["dtype"]),
+                np.empty((B,), np.int32),
+            )
+
         def _acquire():
-            if not self.recycle_buffers:
-                return (
-                    np.empty((B,) + img_meta["shape"], img_meta["dtype"]),
-                    np.empty((B,), np.int32),
-                )
+            # slabs are ALWAYS pooled (workers hold attachments by name);
+            # plain buffers only when recycling was asked for
+            if plane is None and not self.recycle_buffers:
+                return _new_pair()
+            try:
+                return free_q.get_nowait()
+            except queue.Empty:
+                pass
+            if alloc_count[0] < pool_cap:
+                alloc_count[0] += 1
+                return _new_pair()
+            # pool exhausted: one timed-get path (no spin) until a buffer
+            # comes back or the consumer departs
+            t0 = time.monotonic()
             while True:
-                try:
-                    return free_q.get_nowait()
-                except queue.Empty:
-                    pass
-                if alloc_count[0] < pool_cap:
-                    alloc_count[0] += 1
-                    return (
-                        np.empty((B,) + img_meta["shape"], img_meta["dtype"]),
-                        np.empty((B,), np.int32),
-                    )
                 if stop.is_set():
                     raise _Stopped()
                 try:
-                    return free_q.get(timeout=0.1)
+                    pair = free_q.get(timeout=0.1)
+                    break
                 except queue.Empty:
                     continue
+            if plane is not None:
+                plane.note_slab_wait(time.monotonic() - t0)
+            return pair
 
         def producer():
             bad = []  # parse errors absorbed so far (within budget)
@@ -582,6 +636,58 @@ class ImagePipeline:
                 images, labels = _acquire()
                 free_slots = list(range(B))
 
+            def _emit_full():
+                # a full batch goes out; in non-recycle process mode the
+                # slab view is copied out and returned to the pool at once
+                # (the consumer only recycles when recycle_buffers is set)
+                if plane is not None and not self.recycle_buffers:
+                    _emit(np.array(images), labels.copy())
+                    free_q.put((images, labels))
+                else:
+                    _emit(images, labels)
+                _next_buffers()
+
+            def _plane_round(els, slots):
+                """Decode one round on the process plane: cache hits are
+                written inline (already-decoded pixels never cross a
+                process), raw records lease slab slots to the workers, and
+                keyed slots flow back into the decoded cache *via the
+                slab* — no pickle on the result path."""
+                results = []
+                tasks = []
+                keyed = {}
+                for el, slot in zip(els, slots):
+                    if isinstance(el, _Decoded):
+                        try:
+                            images[slot] = el.image
+                            labels[slot] = el.label
+                        except Exception as e:  # shape/dtype mismatch
+                            results.append((slot, _ParseError(e)))
+                        continue
+                    rec, key = el, None
+                    if isinstance(el, _Keyed):
+                        rec, key = el.rec, el.key
+                    if key is not None:
+                        keyed[slot] = key
+                    tasks.append((slot, rec))
+                try:
+                    failures = plane.run_round(
+                        images, labels, tasks, should_stop=stop.is_set
+                    )
+                except decode_plane.Stopped:
+                    raise _Stopped()
+                failed = set()
+                for slot, err in failures:
+                    failed.add(slot)
+                    results.append((slot, _ParseError(err)))
+                for slot, key in keyed.items():
+                    if slot not in failed:
+                        self._decoded[key] = _Decoded(
+                            np.array(images[slot]), int(labels[slot])
+                        )
+                plane.autotune_tick()
+                return results
+
             def _round():
                 # parse all pending records into the lowest free slots;
                 # failures leave holes that the next records backfill, so
@@ -591,7 +697,10 @@ class ImagePipeline:
                     return
                 slots = free_slots[: len(pending)]
                 t0 = time.monotonic()
-                results = list(pool.map(_parse_slot, pending, slots))
+                if plane is not None:
+                    results = _plane_round(pending, slots)
+                else:
+                    results = list(pool.map(_parse_slot, pending, slots))
                 parse_c.inc(time.monotonic() - t0)
                 pending = []
                 holes = []
@@ -602,8 +711,7 @@ class ImagePipeline:
                         holes.append(slot)
                 free_slots = free_slots[len(slots):] + holes
                 if not free_slots:
-                    _emit(images, labels)
-                    _next_buffers()
+                    _emit_full()
 
             def _bootstrap(el):
                 # the first good record defines the batch geometry: its
@@ -622,11 +730,17 @@ class ImagePipeline:
                 labels[0] = p[1]
                 free_slots = free_slots[1:]
                 if not free_slots:
-                    _emit(images, labels)
-                    _next_buffers()
+                    _emit_full()
 
             try:
-                with ThreadPoolExecutor(self.num_threads) as pool:
+                # with a decode plane the parse happens out of process; the
+                # in-process pool (and its threads) never spawns
+                pool_cm = (
+                    contextlib.nullcontext()
+                    if plane is not None
+                    else ThreadPoolExecutor(self.num_threads)
+                )
+                with pool_cm as pool:
                     for rec in self._record_stream(reader_pool, stop, abort, read_c):
                         if stop.is_set():
                             return
@@ -695,6 +809,12 @@ class ImagePipeline:
             # torn down when a half-consumed generator is GC'd at exit)
             while not out_q.empty():
                 out_q.get_nowait()
+            if plane is not None:
+                # the producer observes stop within one poll interval; only
+                # after it is out of the lease protocol is the plane torn
+                # down (workers drained, slab pool unlinked)
+                thread.join(timeout=10.0)
+                plane.close()
 
 
 def device_prefetch(batches, strategy, depth=2):

@@ -31,6 +31,11 @@ logger = logging.getLogger(__name__)
 #: consumer leaves ``tosfeed_*`` files behind; see ``unlink_leaked``)
 NAME_PREFIX = "tosfeed_"
 
+#: /dev/shm name prefix for decode-plane batch slabs (long-lived pooled
+#: segments owned by the creating pipeline, unlike the one-shot ``tosfeed_``
+#: chunks that die at materialize)
+SLAB_PREFIX = "tosslab_"
+
 
 def _unregister_from_tracker(name):
     """The creating process hands the segment's lifetime to the consumer;
@@ -222,12 +227,105 @@ class ShmChunk(Marker):
             logger.warning("failed to discard shm chunk %s", self.name, exc_info=True)
 
 
+class SlabSegment:
+    """One pooled shared-memory slab: a named segment sized for a batch
+    buffer, written in place by decode-plane worker processes and viewed
+    zero-copy by the producer thread.
+
+    Unlike :class:`ShmChunk` (one-shot: created by the feeder, unlinked by
+    the consumer at materialize), a slab lives for the whole pipeline
+    iteration and circulates through a free list — the creating process
+    owns its lifetime end to end. Attachers (worker processes) call
+    :meth:`attach`/:meth:`close`; only the creator calls :meth:`unlink`.
+    """
+
+    __slots__ = ("name", "nbytes", "_seg", "_creator")
+
+    def __init__(self, name, nbytes, seg, creator):
+        self.name = name
+        self.nbytes = nbytes
+        self._seg = seg
+        self._creator = creator
+
+    @classmethod
+    def create(cls, nbytes):
+        """Allocate a fresh ``tosslab_`` segment of ``nbytes`` (creator
+        side). Raises whatever ``shared_memory`` raises when the platform
+        has no usable shm — callers fall back to in-process buffers."""
+        from multiprocessing import shared_memory
+
+        name = SLAB_PREFIX + secrets.token_hex(8)
+        seg = shared_memory.SharedMemory(create=True, size=max(int(nbytes), 1), name=name)
+        return cls(name, seg.size, seg, creator=True)
+
+    @classmethod
+    def attach(cls, name):
+        """Map an existing slab by name (worker side), with the attach-side
+        resource_tracker registration suppressed (pre-3.13 ``SharedMemory``
+        registers on attach unconditionally). Two reasons a worker must not
+        register: a worker forked before the parent's tracker started would
+        spawn its OWN tracker, which unlinks the slab when the worker is
+        chaos-killed; and an unregister-after-register dance is not safe
+        either — forked workers share one tracker whose cache is a set, so
+        N workers' balanced pairs leave N-1 KeyError tracebacks in the
+        tracker when the creator's unlink sends the final unregister."""
+        from multiprocessing import resource_tracker, shared_memory
+
+        orig_register = resource_tracker.register
+        resource_tracker.register = lambda *a, **k: None
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+        finally:
+            resource_tracker.register = orig_register
+        return cls(name, seg.size, seg, creator=False)
+
+    def ndarray(self, shape, dtype, offset=0):
+        """Zero-copy numpy view over the slab (valid until :meth:`close`)."""
+        import numpy as np
+
+        return np.ndarray(shape, dtype=np.dtype(dtype), buffer=self._seg.buf, offset=offset)
+
+    def close(self):
+        """Drop this process's mapping — which UNMAPS it, dangling any live
+        :meth:`ndarray` view (``mmap.close()`` does not honor numpy's base
+        reference; observed as a segfault, not an error). Only for
+        processes about to exit (decode workers at loop end); the creator
+        tears down with :meth:`release` instead."""
+        try:
+            self._seg.close()
+        except BufferError:
+            pass
+
+    def release(self):
+        """Creator-side teardown: unlink the name and hand the mapping's
+        lifetime to the outstanding numpy views. Closing here would unmap
+        under any batch view the consumer still holds (see :meth:`close`),
+        so the SharedMemory finalizer is disarmed instead — the mmap object
+        then lives exactly as long as the last view's base reference and
+        unmaps on its own deallocation. No leak, no dangling view."""
+        self.unlink()
+        self._seg._buf = None
+        self._seg._mmap = None
+
+    def unlink(self):
+        """Remove the segment name (creator side). unlink() already
+        unregisters from this process's tracker; the FileNotFoundError
+        branch balances a lost race the same way ShmChunk.discard does."""
+        try:
+            self._seg.unlink()
+        except FileNotFoundError:
+            _unregister_from_tracker(self.name)
+        except Exception:
+            logger.warning("failed to unlink slab %s", self.name, exc_info=True)
+
+
 def unlink_leaked(max_age_secs=86400):
-    """Best-effort cleanup of ``tosfeed_*`` segments left by crashed
-    consumers (called from executor shutdown). Only touches segments older
-    than ``max_age_secs`` to avoid racing in-flight chunks — the default is
-    deliberately a full day (in-flight backlogs are bounded by feed
-    timeouts, default 600 s); pass 0 only in tests that own every segment."""
+    """Best-effort cleanup of ``tosfeed_*`` / ``tosslab_*`` segments left by
+    crashed consumers (called from executor shutdown). Only touches segments
+    older than ``max_age_secs`` to avoid racing in-flight chunks — the
+    default is deliberately a full day (in-flight backlogs are bounded by
+    feed timeouts, default 600 s); pass 0 only in tests that own every
+    segment."""
     import os
     import time
 
@@ -237,7 +335,7 @@ def unlink_leaked(max_age_secs=86400):
     removed = 0
     now = time.time()
     for fname in os.listdir(shm_dir):
-        if not fname.startswith(NAME_PREFIX):
+        if not fname.startswith((NAME_PREFIX, SLAB_PREFIX)):
             continue
         path = os.path.join(shm_dir, fname)
         try:

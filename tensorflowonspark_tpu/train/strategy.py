@@ -265,17 +265,20 @@ class SyncDataParallel:
         between host-bound and MXU-bound training (no reference analogue: TF
         sessions had the same per-step host loop this removes).
 
-        With ``donate=True`` (default) both the state and the batch list are
-        donated — treat the passed batches as consumed. ``donate="state"``
-        donates only the state (for callers that re-feed the same device
-        batches, e.g. synthetic-input benchmarks). In the packed mode the
-        default ``donate=True`` already means ``"state"``: the ``[K, B,
-        ...]`` input stack aliases no output (a uint8 image stack cannot
-        alias f32 params), so donating it only produced XLA's "donated
-        buffers were not usable" warning and a silent copy (BENCH_r05) —
-        and the prefetch generators keep window buffers referenced for
-        double-buffering, which donation would invalidate. Pass
-        ``donate="batches"`` to force donating the stack anyway.
+        With ``donate=True`` (default) only the state is donated —
+        ``donate=True`` and ``donate="state"`` are the same contract in
+        both modes. Batch stacks must not be offered for donation: the
+        input stack aliases no output (a uint8/f32 image stack cannot
+        alias the param leaves), so donating it only produced XLA's
+        "Some donated buffers were not usable: uint8[...]" warning and a
+        silent copy — BENCH_r05 chased that warning through the bench
+        tail; packed mode was fixed then, and the non-packed loop (the
+        examples' real-data path) had kept the batches donation until
+        now. The prefetch generators also keep window buffers referenced
+        for double-buffering, which donation would invalidate. Pass
+        ``donate="batches"`` to force donating the batch list anyway
+        (callers that truly consume their device batches and want the
+        HBM back a window early).
 
         ``packed=True`` flips the input contract: ``loop(state, stacked)``
         takes ONE device-resident pytree whose leaves carry a leading
@@ -317,10 +320,10 @@ class SyncDataParallel:
             # metrics of the LAST step (scan stacks them; take index -1)
             return state, jax.tree.map(lambda m: m[-1], metrics)
 
-        if packed and donate is True:
+        if donate is True:
             donate = "state"
         donate_argnums = {
-            True: (0, 1), "batches": (0, 1), "state": (0,), False: (),
+            "batches": (0, 1), "state": (0,), False: (),
         }[donate]
         return jax.jit(loop, donate_argnums=donate_argnums)
 

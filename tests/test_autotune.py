@@ -313,6 +313,31 @@ class TestDonationSafety:
         n_window_leaves = len(jax.tree.leaves(window))
         assert forced == state_only + n_window_leaves
 
+    def test_unpacked_default_donates_state_not_batches(self):
+        # same contract for the NON-packed loop (the examples' real-data
+        # path): donate=True marks state leaves only — the batch-list
+        # donation was what kept the "Some donated buffers were not
+        # usable: uint8[...]" warning alive in the bench tail
+        strategy = _strategy()
+        k = 4
+        optimizer = optax.sgd(0.05)
+        batches = [strategy.shard_batch(b) for b in _xy_batches(k)]
+
+        def donors(donate):
+            state = strategy.create_state(
+                _linear_init, optimizer, jax.random.PRNGKey(0)
+            )
+            loop = strategy.compile_train_loop(
+                _linear_loss, optimizer, k, donate=donate, packed=False
+            )
+            return loop.lower(state, batches).as_text().count("jax.buffer_donor")
+
+        default, state_only, forced = donors(True), donors("state"), donors("batches")
+        assert donors(False) == 0
+        assert default == state_only > 0
+        n_batch_leaves = len(jax.tree.leaves(batches))
+        assert forced == state_only + n_batch_leaves
+
 
 @pytest.mark.chaos
 @pytest.mark.perf_smoke

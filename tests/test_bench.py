@@ -120,6 +120,10 @@ def test_feed_fields_reports_link_estimate_and_stalls():
     assert set(out["stalls"]) == {
         "producer_read_seconds", "producer_parse_seconds",
         "producer_emit_seconds", "consumer_wait_seconds",
+        "classification",
+    }
+    assert out["stalls"]["classification"] in {
+        "device_bound", "decode_bound", "io_bound",
     }
 
     tuner.note_fixed_probe(0.25)
@@ -129,3 +133,41 @@ def test_feed_fields_reports_link_estimate_and_stalls():
     assert out["autotuned_k"] in tuner.buckets
     assert out["link_fixed_cost_seconds"] == pytest.approx(0.25, abs=1e-3)
     assert out["link_bytes_per_sec"] == pytest.approx(20e6, rel=1e-2)
+
+
+def test_classify_stalls_covers_all_three_bottlenecks():
+    # producer blocked on the full queue >= consumer starvation: device gates
+    assert bench.classify_stalls(1.0, 1.0, 5.0, 2.0) == "device_bound"
+    # input path gates, parse dominates shard IO: the decode stage
+    assert bench.classify_stalls(1.0, 3.0, 0.0, 2.0) == "decode_bound"
+    # input path gates, shard IO dominates parse
+    assert bench.classify_stalls(3.0, 1.0, 0.0, 2.0) == "io_bound"
+
+
+def test_least_implausible_pair_picks_log_symmetric_winner():
+    # ratios 3.30, 0.5, 2.0 — |log| says 2.0 and 0.5 tie at log 2, 3.30
+    # loses; min() resolves the tie to the first, but the outlier must
+    # never win
+    nc = [100.0, 100.0, 100.0]
+    tr = [330.0, 50.0, 200.0]
+    assert bench.least_implausible_pair(nc, tr) in {(100.0, 50.0), (100.0, 200.0)}
+
+    # an actual near-1.0 ratio beats both halves of the band
+    tr2 = [330.0, 50.0, 108.0]
+    assert bench.least_implausible_pair(nc, tr2) == (100.0, 108.0)
+
+    # symmetric: 0.9 and 1/0.9 are equally plausible, both beat 3.30
+    assert bench.least_implausible_pair([100.0, 100.0], [90.0, 330.0]) == (100.0, 90.0)
+
+
+def test_all_invalid_fallback_admits_one_pair_not_the_raw_set():
+    # the r05 regression: every pair out of band used to readmit the whole
+    # raw set, letting a 3.30 outlier into the headline median — the
+    # fallback must now surface exactly one least-implausible pair
+    nc = [100.0, 100.0]
+    tr = [330.0, 250.0]
+    valid, invalid = bench.partition_pairs(nc, tr)
+    assert valid == []
+    assert len(invalid) == 2
+    best = bench.least_implausible_pair(nc, tr)
+    assert best == (100.0, 250.0)

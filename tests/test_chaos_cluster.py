@@ -72,6 +72,38 @@ def fn_pipeline_under_chaos(args, ctx):
             feed.batch_results([n for _ in batch])
 
 
+def _parse_2x2(rec):
+    # module-level: decode-plane workers are forked, the parse fn must be
+    # importable/fork-inheritable
+    import numpy as np
+
+    v = int(rec)
+    return np.full((2, 2, 1), v, np.float32), v
+
+
+def fn_decode_plane_under_chaos(args, ctx):
+    # the decode plane runs inside the spawned jax child; the chaos kill
+    # SIGKILLs one worker mid-round and the respawned pool must deliver
+    # every record exactly once — the child proves the stream intact and
+    # the fault/restart counters travel back through the metrics merge
+    from tensorflowonspark_tpu import chaos as _chaos
+    from tensorflowonspark_tpu.data import ImagePipeline
+
+    assert _chaos.active, "chaos plan did not reach the jax child"
+
+    pipe = ImagePipeline(
+        [args["shard"]], _parse_2x2, batch_size=4, shuffle=False, epochs=1,
+        decode_workers=2,
+    )
+    labels = [int(x) for b in pipe for x in b["label"]]
+    ok = labels == list(range(16))
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        batch = feed.next_batch(16)
+        if batch:
+            feed.batch_results([int(ok) for _ in batch])
+
+
 class TestClusterChaos:
     def test_faults_injected_and_recovered_across_the_cluster(self, sc):
         plan = (
@@ -159,5 +191,52 @@ class TestClusterChaos:
                     break
                 time.sleep(0.5)
             assert faults >= 1
+        finally:
+            cluster.shutdown(timeout=120)
+
+    def test_decode_kill_respawns_without_losing_rows(self, sc, tmp_path):
+        import importlib.util
+
+        if importlib.util.find_spec("multiprocessing.shared_memory") is None:
+            pytest.skip("no shared_memory on this platform")
+        from tensorflowonspark_tpu import tfrecord
+
+        shard = str(tmp_path / "part-00000")
+        with tfrecord.TFRecordWriter(shard) as w:
+            for i in range(16):
+                w.write(str(i).encode())
+
+        plan = chaos.ChaosPlan(seed=11).site(
+            "data.decode_kill", probability=1.0, max_count=1
+        )
+        chaos.install(plan)  # propagate=True: children inherit via env
+        cluster = TFCluster.run(
+            sc, fn_decode_plane_under_chaos, {"shard": shard}, num_executors=2,
+            input_mode=InputMode.SPARK, master_node=None,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
+        )
+        try:
+            # every child's stream survived the worker kill intact
+            results = cluster.inference(sc.parallelize(range(8), 4)).collect()
+            assert results == [1] * 8
+
+            # child counters arrive on the SnapshotPublisher interval
+            deadline = time.monotonic() + 60
+            while True:
+                snap = cluster.metrics()
+                counters = snap["counters"]
+                kills = (
+                    counters.get("chaos_fault_data_decode_kill_total", {})
+                    .get("value", 0)
+                )
+                restarts = (
+                    counters.get("decode_worker_restarts_total", {})
+                    .get("value", 0)
+                )
+                if (kills >= 1 and restarts >= 1) or time.monotonic() > deadline:
+                    break
+                time.sleep(0.5)
+            assert kills >= 1
+            assert restarts >= 1
         finally:
             cluster.shutdown(timeout=120)

@@ -1,7 +1,9 @@
 """Pipelined input path (data/loader.py): determinism across every
 pipelining knob, bounded shuffle-buffer behaviour, stall metrics, recycled
-zero-copy batch buffers, chaos ``data.shard_read`` faults, and the
-structural IO/parse overlap proof (``perf_smoke``)."""
+zero-copy batch buffers, the multiprocess decode-plane mode (byte-identical
+to the thread pool, caches/budget across the process boundary), chaos
+``data.shard_read`` faults, and the structural IO/parse overlap proof
+(``perf_smoke``)."""
 
 import time
 
@@ -218,6 +220,99 @@ class TestRecycledBuffers:
         # batches proves reuse (fresh np.empty per batch would churn ids)
         assert n_batches == 2 * (411 // 8)
         assert len(ids) <= 3
+
+
+class TestDecodePlaneMode:
+    """``decode_workers > 0``: the parse stage runs in worker processes
+    writing into shared-memory slabs — the delivered stream must stay
+    byte-identical to the thread pool's, across every pipelining knob, and
+    the caches/budget/fallback contracts must hold either side of the
+    process boundary."""
+
+    def test_stream_invariant_across_decode_workers(self, shards):
+        base = _stream(shards, readahead=0, chunk_records=0, num_threads=1)
+        variants = [
+            dict(decode_workers=1, readahead=0, chunk_records=0),
+            dict(decode_workers=1, readahead=2, chunk_records=16),
+            dict(decode_workers=4, readahead=0, chunk_records=0),
+            dict(decode_workers=4, readahead=2, chunk_records=16),
+            dict(decode_workers=4, readahead=3, chunk_records=7),
+        ]
+        for kw in variants:
+            assert _stream(shards, **kw) == base, kw
+
+    def test_env_knob_engages_the_plane(self, shards, monkeypatch):
+        from tensorflowonspark_tpu import obs
+
+        base = _stream(shards)
+        monkeypatch.setenv("TOS_DECODE_WORKERS", "2")
+        assert _stream(shards) == base
+        # the plane ran: its gauge got registered (back at 0 after close)
+        assert "decode_workers" in obs.snapshot()["gauges"]
+        assert obs.snapshot()["gauges"]["decode_workers"]["value"] == 0
+
+    def test_thread_fallback_when_plane_unavailable(self, shards, monkeypatch):
+        from tensorflowonspark_tpu.data import decode_plane
+
+        base = _stream(shards)
+        monkeypatch.setattr(decode_plane, "available", lambda: False)
+        assert _stream(shards, decode_workers=4) == base
+
+    def test_decoded_cache_populated_from_process_workers(self, shards):
+        # decoded pixels flow back through the slab (never pickle) into the
+        # parent's cache; epoch 2 replays from it byte-identically
+        base = _stream(shards, readahead=2, chunk_records=16)
+        pipe = ImagePipeline(
+            shards, _parse, batch_size=8, seed=3, epochs=2,
+            readahead=2, chunk_records=16, cache="decoded", decode_workers=2,
+        )
+        got = [(b["image"].tobytes(), b["label"].tobytes()) for b in pipe]
+        assert got == base
+        assert len(pipe._decoded) == 411
+        # replay is served from the parent-side cache, process mode again
+        second = [(b["image"].tobytes(), b["label"].tobytes()) for b in pipe]
+        assert second == got
+
+    def test_recycled_slabs_match_when_copied(self, shards):
+        base = _stream(shards, readahead=2, chunk_records=16)
+        pipe = ImagePipeline(
+            shards, _parse, batch_size=8, seed=3, epochs=2,
+            readahead=2, chunk_records=16, recycle_buffers=True,
+            decode_workers=2,
+        )
+        got = [(b["image"].copy().tobytes(), b["label"].copy().tobytes()) for b in pipe]
+        assert got == base
+
+    def test_max_bad_records_budget_spans_the_process_boundary(self, tmp_path):
+        # the poisoned record fails INSIDE a worker; the budget and the
+        # skip counter must behave exactly as in-thread (holes backfilled,
+        # batches stay full-size)
+        p = str(tmp_path / "part-00000")
+        with tfrecord.TFRecordWriter(p) as w:
+            for i in range(20):
+                w.write(str(i).encode() if i != 7 else b"poison")
+
+        def run(max_bad):
+            pipe = ImagePipeline(
+                [p], _parse, batch_size=4, seed=0, epochs=1, shuffle=False,
+                max_bad_records=max_bad, decode_workers=2,
+            )
+            return [int(x) for b in pipe for x in b["label"]]
+
+        before = _counter("data_records_skipped_total")
+        assert run(1) == [i for i in range(20) if i != 7][:16]
+        assert _counter("data_records_skipped_total") == before + 1
+        with pytest.raises(Exception, match="poison"):
+            run(0)
+
+    def test_slab_metrics_registered(self, shards):
+        from tensorflowonspark_tpu import obs
+
+        _stream(shards, decode_workers=2, recycle_buffers=True)
+        snap = obs.snapshot()
+        assert "decode_slab_bytes" in snap["gauges"]
+        assert "decode_worker_restarts_total" in snap["counters"]
+        assert "decode_slab_wait_seconds_total" in snap["counters"]
 
 
 class TestChaosShardRead:
