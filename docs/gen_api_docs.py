@@ -29,6 +29,7 @@ MODULES = [
     "tensorflowonspark_tpu.TFManager",
     "tensorflowonspark_tpu.TFParallel",
     "tensorflowonspark_tpu.reservation",
+    "tensorflowonspark_tpu.registry",
     "tensorflowonspark_tpu.pipeline",
     "tensorflowonspark_tpu.dfutil",
     "tensorflowonspark_tpu.tfrecord",

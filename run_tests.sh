@@ -111,6 +111,13 @@ if [[ "$CHAOS" == "1" ]]; then
   # cluster metrics.
   echo "chaos leg: node.kill recovery-ladder run"
   python -m pytest tests/test_elastic.py -q -m "chaos and slow"
+  # control-plane leg (also self-installed plans): control.driver_crash
+  # drops the membership registry mid-watch (after control.journal_tear
+  # tore the manifest publish) — recovery replays the journal, re-adopts
+  # every live lease with zero relaunches and a bumped epoch; plus the
+  # benign control.lease_delay run. Asserted from merged cluster metrics.
+  echo "chaos leg: control.driver_crash registry-recovery run"
+  python -m pytest tests/test_chaos_control.py -q -m "chaos and slow"
   # Benign-in-outcome sites at low probability: the suite's assertions
   # must keep passing — most sites only perturb timing; data.decode_kill
   # SIGKILLs a decode worker, which the plane's respawn-and-release
@@ -124,6 +131,7 @@ if [[ "$CHAOS" == "1" ]]; then
     "data.decode_kill":     {"probability": 0.05, "max_count": null},
     "serving.latency":      {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "reservation.slow_accept": {"probability": 0.05, "max_count": null, "delay_s": 0.01},
+    "control.lease_delay":  {"probability": 0.05, "max_count": null, "delay_s": 0.005},
     "ckpt.snapshot_stall":  {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "ckpt.write_slow":      {"probability": 0.05, "max_count": null, "delay_s": 0.01}
   }}'

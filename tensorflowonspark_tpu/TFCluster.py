@@ -24,6 +24,7 @@ import threading
 import time as _time
 
 from tensorflowonspark_tpu import TFSparkNode, TFManager, chaos, reservation, resilience
+from tensorflowonspark_tpu import registry as membership
 from tensorflowonspark_tpu.obs import aggregate as obs_aggregate
 from tensorflowonspark_tpu.obs import registry as obs_registry
 
@@ -72,7 +73,7 @@ def _abort_nodes(cluster_info, authkey, reason):
 class TFCluster:
     """Handle to a running cluster; constructed by :func:`run`."""
 
-    def __init__(self, sc, cluster_info, cluster_meta, input_mode, server, launch_thread, tf_status, num_workers, worker_executor_ids):
+    def __init__(self, sc, cluster_info, cluster_meta, input_mode, server, launch_thread, tf_status, num_workers, worker_executor_ids, registry=None):
         self.sc = sc
         self.cluster_info = cluster_info
         self.cluster_meta = cluster_meta
@@ -83,79 +84,166 @@ class TFCluster:
         self.num_workers = num_workers
         self.worker_executor_ids = worker_executor_ids
         self.queues = cluster_meta["queues"]
+        # membership truth: constructed by run() (journal-backed when a
+        # registry_dir was given); direct constructions get an in-memory one
+        if registry is None:
+            registry = membership.MembershipRegistry()
+            registry.begin_generation(
+                {r["executor_id"]: (r["job_name"], r["task_index"]) for r in cluster_info or []}
+            )
+        self.registry = registry
+        for row in cluster_info or []:
+            # idempotent: the reservation server already joined registered
+            # rows; this covers directly-constructed clusters
+            self.registry.join(
+                row["executor_id"], job_name=row["job_name"], task_index=row["task_index"]
+            )
         self._monitor_stop = None
         self._start_monitor()
 
     # -- failure watchdog ------------------------------------------------------
 
     def _start_monitor(self, interval=None, stale_secs=None):
-        """Driver-side watchdog: polls every node channel so a crashed child
-        surfaces within seconds, not at shutdown (VERDICT r2 item 7; the
-        reference only polled error queues from feed tasks and at teardown,
-        TFCluster.py:136-144,178-183).
+        """Driver-side watchdog, registry-driven: every liveness signal is a
+        lease transition on :attr:`registry`, and failure is lease *expiry*
+        (VERDICT r2 item 7; the reference only polled error queues from feed
+        tasks and at teardown, TFCluster.py:136-144,178-183).
 
-        Two signals per node: (a) the error queue (peeked non-destructively —
-        a posted traceback stays visible to the shutdown path), (b) the
-        child heartbeat counter — a child that dies without posting (SIGKILL,
-        OOM) stops beating and is flagged after ``stale_secs`` without
-        progress. Findings land in ``tf_status`` (checked by feeders, the
-        shutdown join loop, and :meth:`check_errors`).
+        Signals, in priority order per node: (a) the error queue (peeked
+        non-destructively — a posted traceback stays visible to the shutdown
+        path), (b) a final ``child_status`` → ``registry.leave`` (clean
+        release), (c) the child heartbeat counter → ``registry.renew`` —
+        renewal happens only when the counter *advances*, so a SIGKILLed
+        child's frozen counter stops renewing and its lease expires after
+        the TTL (``TOS_HEARTBEAT_STALE``). Beat delivery is tiered: nodes
+        covered by a live heartbeat-aggregation window
+        (:func:`registry.plan_aggregation_tree`) are renewed from the
+        aggregator's summary — O(sqrt N) driver sockets — and fall back to
+        direct channel polls when their aggregator goes quiet. Expiries land
+        in ``tf_status`` (checked by feeders, the shutdown join loop, and
+        :meth:`check_errors`) with the executor id in the message, which is
+        what ``elastic.classify_failure`` attributes ``lease_expired``
+        events from.
+
+        The ``control.driver_crash`` chaos site is consulted here: firing it
+        discards the in-memory registry without a parting commit and
+        recovers a fresh one from the journal, exactly as a restarted driver
+        process would (:meth:`_simulate_driver_restart`).
         """
         interval = interval or float(os.environ.get("TOS_MONITOR_INTERVAL", "3"))
         stale_secs = stale_secs or float(os.environ.get("TOS_HEARTBEAT_STALE", "30"))
+        self.registry.ttl = float(stale_secs)
         stop = threading.Event()
         self._monitor_stop = stop
-        last_beat = {}  # executor_id -> (value, local time it changed)
         channels = {}
+        rows_by_eid = {
+            r["executor_id"]: r for r in self.cluster_info or [] if r.get("manager_addr")
+        }
+        tree = (
+            membership.plan_aggregation_tree(rows_by_eid.values())
+            if membership.aggregation_enabled(len(rows_by_eid))
+            else {}
+        )
+        window_secs = membership.WINDOW_SECS
+        # a window is live while its counter keeps changing; after this long
+        # without a change the aggregator is presumed dead and its members
+        # fall back to direct polls
+        window_horizon = 3.0 * window_secs + interval
+        window_state = {}  # aggregator eid -> (window counter, monotonic seen)
 
-        def _poll_node(row):
+        def _connect(eid):
             import socket as _socket
 
-            key = row["executor_id"]
-            mgr = channels.get(key)
+            mgr = channels.get(eid)
             if mgr is None:
                 # cheap bounded reachability probe first: BaseManager.connect
                 # has no timeout, and one unreachable (NAT'd) node must not
                 # stall the single monitor thread for the OS connect timeout
                 # every cycle
-                addr = tuple(row["manager_addr"])
+                addr = tuple(rows_by_eid[eid]["manager_addr"])
                 with _socket.create_connection(addr, timeout=2):
                     pass
                 mgr = TFManager.connect(addr, self.cluster_meta["authkey"])
-                channels[key] = mgr
-            tb = TFSparkNode.peek_error(mgr)
+                channels[eid] = mgr
+            return mgr
+
+        def _node_error(eid):
+            """Fetch a posted traceback from one node (non-destructive)."""
+            row = rows_by_eid[eid]
+            tb = TFSparkNode.peek_error(_connect(eid))
             if tb is not None:
                 return "node {}:{} failed:\n{}".format(row["job_name"], row["task_index"], tb)
+            return None
+
+        def _poll_direct(eid):
+            """Direct channel poll: error → status(leave) → beat(renew)."""
+            problem = _node_error(eid)
+            if problem is not None:
+                return problem
+            mgr = _connect(eid)
             status = mgr.get("child_status")
             if status is not None:
-                last_beat.pop(key, None)  # exited cleanly/already reported
+                self.registry.leave(eid, reason=str(status))
                 return None
-            beat = mgr.get("heartbeat")
-            if beat is None:
-                return None  # child not up yet
-            prev = last_beat.get(key)
-            now = _time.monotonic()
-            if prev is None or prev[0] != beat:
-                last_beat[key] = (beat, now)
-                return None
-            if now - prev[1] > stale_secs:
-                return (
-                    "node {}:{} stopped heartbeating for {:.0f}s without a "
-                    "final status (child killed?)".format(
-                        row["job_name"], row["task_index"], now - prev[1]
-                    )
-                )
+            self.registry.renew(eid, beat=mgr.get("heartbeat"))
             return None
+
+        def _apply_window(agg_eid):
+            """Read one aggregator's window summary; returns the set of
+            member eids it covered (empty → stale, members poll directly)."""
+            import json as _json
+
+            raw = _connect(agg_eid).get(membership.WINDOW_KEY)
+            if not raw:
+                return set(), {}
+            summary = _json.loads(raw)
+            now = _time.monotonic()
+            prev = window_state.get(agg_eid)
+            if prev is None or prev[0] != summary.get("window"):
+                window_state[agg_eid] = (summary.get("window"), now)
+            elif now - prev[1] > window_horizon:
+                return set(), {}  # aggregator stopped publishing
+            covered, problems = set(), {}
+            statuses = summary.get("status") or {}
+            beats = summary.get("beats") or {}
+            flagged = set(summary.get("errors") or [])
+            for eid in tree[agg_eid]:
+                if eid not in rows_by_eid:
+                    continue
+                covered.add(eid)
+                if eid in flagged:
+                    try:
+                        problem = _node_error(eid)
+                    except Exception:
+                        problem = None
+                    if problem is not None:
+                        problems[eid] = problem
+                        continue
+                if str(eid) in statuses:
+                    self.registry.leave(eid, reason=str(statuses[str(eid)]))
+                    continue
+                self.registry.renew(eid, beat=beats.get(str(eid)))
+            return covered, problems
 
         def _monitor():
             reported = set()
             poll_errors_logged = set()  # log an unreachable channel once per node
             while not stop.wait(interval):
-                for row in self.cluster_info or []:
-                    if not row.get("manager_addr") or row["executor_id"] in reported:
+                if chaos.active and chaos.fire("control.driver_crash"):
+                    self._simulate_driver_restart()
+                covered, problems = set(), {}
+                for agg_eid in tree:
+                    try:
+                        got, agg_problems = _apply_window(agg_eid)
+                    except Exception:
+                        continue  # aggregator unreachable: members poll directly
+                    covered |= got
+                    problems.update(agg_problems)
+                for eid in rows_by_eid:
+                    if eid in covered or eid in reported or eid in problems:
                         continue
                     try:
-                        problem = _poll_node(row)
+                        problem = _poll_direct(eid)
                     except Exception as e:
                         # channel unreachable: shutdown's concern — but count
                         # it, so a node the watchdog can never see is visible
@@ -163,20 +251,68 @@ class TFCluster:
                             "watchdog_poll_errors_total",
                             help="watchdog node polls that raised (channel unreachable)",
                         ).inc()
-                        if row["executor_id"] not in poll_errors_logged:
-                            poll_errors_logged.add(row["executor_id"])
+                        if eid not in poll_errors_logged:
+                            poll_errors_logged.add(eid)
+                            row = rows_by_eid[eid]
                             logger.debug(
                                 "watchdog: cannot poll node %s:%s: %s",
                                 row["job_name"], row["task_index"], e,
                             )
                         continue
-                    poll_errors_logged.discard(row["executor_id"])
+                    poll_errors_logged.discard(eid)
                     if problem:
-                        reported.add(row["executor_id"])
-                        logger.error("watchdog: %s", problem)
-                        self.tf_status.setdefault("error", problem)
+                        problems[eid] = problem
+                for eid, age in self.registry.expire_stale():
+                    if eid in reported or eid in problems:
+                        continue
+                    row = rows_by_eid.get(eid)
+                    job, task = (
+                        (row["job_name"], row["task_index"]) if row else ("worker", "?")
+                    )
+                    # wording carries three contracts: "stopped heartbeating"
+                    # (historical operator-facing phrasing), "lease expired"
+                    # (elastic's lease_expired classification), and
+                    # "(executor N)" (elastic's id attribution)
+                    problems[eid] = (
+                        "node {}:{} stopped heartbeating: lease expired after "
+                        "{:.0f}s without renewal (executor {})".format(job, task, age, eid)
+                    )
+                for eid in sorted(p for p in problems if p not in reported):
+                    reported.add(eid)
+                    logger.error("watchdog: %s", problems[eid])
+                    self.tf_status.setdefault("error", problems[eid])
 
         threading.Thread(target=_monitor, name="tos-watchdog", daemon=True).start()
+
+    def _simulate_driver_restart(self):
+        """``control.driver_crash``: drop the registry with no parting commit
+        (a crash does not say goodbye) and bring up a replacement the way a
+        restarted driver process would — journal replay, live-lease
+        re-adoption, epoch bump (fencing any stale writer). Executors are
+        untouched: their children keep training, their leases keep renewing
+        against the recovered registry. Rows the journal had not yet
+        captured (or with no journal at all) are re-adopted from the
+        assembly snapshot — their in-flight REG already proved them alive."""
+        old = self.registry
+        logger.warning(
+            "chaos: control.driver_crash — dropping registry (epoch %d) and "
+            "recovering from journal %s", old.epoch, old.journal_dir,
+        )
+        old.crash()
+        self.registry = membership.MembershipRegistry.recover(
+            old.journal_dir, ttl=old.ttl, fallback_epoch=old.epoch
+        )
+        for row in self.cluster_info or []:
+            if row["executor_id"] not in self.registry.members():
+                self.registry.join(
+                    row["executor_id"],
+                    job_name=row["job_name"],
+                    task_index=row["task_index"],
+                )
+        obs_registry.counter(
+            "registry_driver_restarts_total",
+            help="driver registry crash/recover cycles (chaos or real)",
+        ).inc()
 
     def _current_rows(self):
         """Freshest node rows. Real Spark retries a failed launch task, and
@@ -731,6 +867,8 @@ def run(
     jax_distributed=None,
     obs=None,
     blacklist=None,
+    registry=None,
+    registry_dir=None,
 ):
     """Start a cluster: one node per executor (reference TFCluster.py:212-380).
 
@@ -746,6 +884,13 @@ def run(
     skips them, the launch RDD never pins a task to them, and the reservation
     server refuses a late registration from one — the recovery ladder's lever
     (:mod:`~tensorflowonspark_tpu.elastic`).
+    ``registry`` is an existing
+    :class:`~tensorflowonspark_tpu.registry.MembershipRegistry` to reuse
+    (the recovery ladder passes one across attempts so the epoch and
+    blacklist journal survive relaunches); ``registry_dir`` (env
+    ``TOS_REGISTRY_DIR``) backs a fresh registry with an on-disk journal —
+    the driver-restart survivability lever. With neither, membership is
+    tracked in memory only.
     """
     if obs is None:
         obs = os.environ.get("TOS_OBS", "1") != "0"
@@ -768,8 +913,21 @@ def run(
         jax_distributed = num_workers > 1
     logger.info("cluster template: %s", {e: "{}:{}".format(j, t) for e, (j, t) in template.items()})
 
+    if registry is None:
+        registry_dir = registry_dir or os.environ.get("TOS_REGISTRY_DIR") or None
+        registry = membership.MembershipRegistry(
+            ttl=float(os.environ.get("TOS_HEARTBEAT_STALE", "30")),
+            journal_dir=registry_dir,
+        )
+    registry.begin_generation(template)
+    for eid in blacklist or ():
+        # one membership truth: the caller's static blacklist is mirrored
+        # into (and journaled by) the registry
+        registry.blacklist(eid, reason="caller blacklist")
+
     server = reservation.Server(
-        num_executors, expected_ids=executor_ids, blacklist=blacklist
+        num_executors, expected_ids=executor_ids, blacklist=blacklist,
+        registry=registry,
     )
     server_addr = server.start()
 
@@ -859,5 +1017,5 @@ def run(
         )
     return TFCluster(
         sc, cluster_info, cluster_meta, input_mode, server, launch_thread, tf_status,
-        num_workers, worker_executor_ids,
+        num_workers, worker_executor_ids, registry=registry,
     )

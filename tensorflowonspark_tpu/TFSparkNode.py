@@ -330,10 +330,15 @@ def _start_heartbeat(mgr, executor_id=None):
 
     def _beat():
         failures = 0
-        ticker = resilience.Backoff(
-            base=HEARTBEAT_INTERVAL, factor=1.0, max_delay=HEARTBEAT_INTERVAL, jitter=0.0
+        # drift-free monotonic schedule with per-beat jitter: N children
+        # started out of the same assembly barrier must not beat in
+        # lockstep, or the aggregation tree turns the fleet's beats into
+        # synchronized channel bursts (seeded by executor id so tests can
+        # reproduce a schedule)
+        ticker = resilience.Ticker(
+            HEARTBEAT_INTERVAL, jitter=0.25, seed=executor_id
         )
-        for n in ticker.attempts():
+        for n in ticker.ticks():
             if chaos.active:
                 _chaos_node_fault(n)
             try:
@@ -448,6 +453,8 @@ class _NodeLaunchTask:
         ids = [r["executor_id"] for r in cluster_info]
         if len(set(ids)) != len(ids):
             raise RuntimeError("duplicate executor ids in cluster: {}".format(sorted(ids)))
+
+        self._maybe_start_aggregator(mgr, cluster_info, executor_id, authkey, meta)
 
         cluster_spec = {}
         for row in sorted(cluster_info, key=lambda r: (_role_rank(r["job_name"]), r["task_index"])):
@@ -564,6 +571,44 @@ class _NodeLaunchTask:
                     )
                 )
         return []
+
+    @staticmethod
+    def _maybe_start_aggregator(mgr, cluster_info, executor_id, authkey, meta):
+        """Start the heartbeat aggregation thread when this executor is an
+        elected aggregator for the assembled cluster.
+
+        The election (:func:`registry.plan_aggregation_tree`) is a pure
+        function of ``cluster_info``, so every executor and the driver agree
+        on the tree without another rendezvous round-trip. The thread is a
+        daemon on the *executor* process (which outlives the launch task in
+        spark mode via ``_live_channels``), publishing per-window beat
+        summaries on this node's own channel; the driver's watchdog reads
+        those instead of polling every member directly. Failure to start is
+        non-fatal — the driver falls back to direct polls."""
+        from tensorflowonspark_tpu import registry as registry_mod
+
+        try:
+            if not registry_mod.aggregation_enabled(len(cluster_info)):
+                return
+            tree = registry_mod.plan_aggregation_tree(cluster_info)
+            members = tree.get(executor_id)
+            if not members:
+                return
+            rows = {r["executor_id"]: r for r in cluster_info}
+            agg = registry_mod.HeartbeatAggregator(
+                mgr,
+                [rows[m] for m in members if m in rows],
+                authkey,
+                obs_enabled=bool(meta.get("obs", True)),
+            )
+            agg.start()
+            logger.info(
+                "executor %d aggregating heartbeats for members %s",
+                executor_id, members,
+            )
+        except Exception:
+            logger.exception("heartbeat aggregator failed to start; "
+                             "driver will poll members directly")
 
     @staticmethod
     def _start_abort_watch(mgr, child, job_name, task_index):

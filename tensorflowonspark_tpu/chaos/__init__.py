@@ -80,6 +80,19 @@ site                            effect at the injection point
 ``node.flap``                   heartbeat loop stalls ``delay_s`` (``victim``,
                                 ``after_beats`` as above) — a transient loss
                                 that should NOT lead to a blacklist
+``control.driver_crash``        watchdog drops the in-memory membership
+                                registry with no parting commit and recovers
+                                it from the journal under a bumped epoch —
+                                a driver restart mid-train; live executors
+                                must be re-adopted without relaunch
+``control.lease_delay``         registry lease renewal sleeps ``delay_s`` —
+                                benign control-plane latency that must not
+                                expire healthy leases
+``control.journal_tear``        registry manifest publish dies half-written
+                                (or with ``target: "journal"`` a journal
+                                append is torn); recovery must detect the
+                                CRC mismatch and fall back to the previous
+                                committed manifest plus journal replay
 ``serving.latency``             predictor sleeps before dispatch
 ``serving.conn_drop``           server closes the connection mid-request
 ``serving.overload``            submit sheds with ``Overloaded``

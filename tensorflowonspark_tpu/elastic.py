@@ -7,10 +7,10 @@ re-reserves the same dead capacity and burns the whole budget failing
 identically. This module upgrades that loop into a **recovery ladder**:
 
 1. **Classify** — every failed attempt becomes a :class:`FailureEvent` with a
-   kind (``launch`` / ``reservation_timeout`` / ``heartbeat_loss`` /
-   ``node_exit`` / ``node_error`` / ``feed_timeout`` / ``unknown``) and, where
-   the failure text or exception chain allows, the executor ids it implicates
-   (:func:`classify_failure`). The :class:`FailureLedger` keeps these in a
+   kind (``launch`` / ``reservation_timeout`` / ``lease_expired`` /
+   ``heartbeat_loss`` / ``node_exit`` / ``node_error`` / ``feed_timeout`` /
+   ``unknown``) and, where the failure text or exception chain allows, the
+   executor ids it implicates (:func:`classify_failure`). The :class:`FailureLedger` keeps these in a
    sliding window and enforces the restart budget against the *window*, not
    all time — a cluster that fails once a week is healthy; one that fails
    three times in an hour is not.
@@ -41,15 +41,21 @@ import re
 import time
 
 from tensorflowonspark_tpu import TFCluster, TFSparkNode, obs, reservation
+from tensorflowonspark_tpu import registry as membership
 
 logger = logging.getLogger(__name__)
 
 #: failure kinds that implicate a *node* (vs. the control plane or the feed):
 #: only these count toward an executor's blacklist score
-LOSS_KINDS = frozenset({"heartbeat_loss", "node_exit", "reservation_timeout"})
+LOSS_KINDS = frozenset(
+    {"heartbeat_loss", "lease_expired", "node_exit", "reservation_timeout"}
+)
 
 _NODE_RE = re.compile(r"node (\w+):(\d+)")
 _EXIT_RE = re.compile(r"failed \(exit (-?\d+)\)")
+#: the registry watchdog stamps the executor id directly into the message —
+#: attribution without a role_map round-trip
+_EXEC_RE = re.compile(r"\(executor (\d+)\)")
 
 
 class FailureEvent:
@@ -103,9 +109,15 @@ def classify_failure(exc, role_map=None):
         key = "{}:{}".format(job, task)
         if key in role_map:
             executor_ids.add(role_map[key])
+    for eid in _EXEC_RE.findall(text):
+        executor_ids.add(int(eid))
 
     if missing or any(isinstance(c, reservation.ReservationError) for c in chain):
         return FailureEvent("reservation_timeout", executor_ids | set(missing), text)
+    if "lease expired" in text:
+        # the registry watchdog's first-class expiry event; checked before
+        # the legacy phrasing because its messages contain both
+        return FailureEvent("lease_expired", executor_ids, text)
     if "stopped heartbeating" in text:
         return FailureEvent("heartbeat_loss", executor_ids, text)
     if "feed timeout" in text:
@@ -330,6 +342,17 @@ def run_ladder(
             blacklist_after=blacklist_after,
         )
     overhead = run_kwargs.get("num_ps", 0) + (1 if run_kwargs.get("eval_node") else 0)
+    # ONE membership registry across every attempt: each relaunch is a new
+    # generation under a higher epoch, and the blacklist is journaled so a
+    # restarted driver inherits the ladder's condemnations, not just the
+    # current attempt's roster
+    registry = run_kwargs.pop("registry", None)
+    if registry is None:
+        registry = membership.MembershipRegistry(
+            journal_dir=run_kwargs.pop("registry_dir", None)
+        )
+    else:
+        run_kwargs.pop("registry_dir", None)
     blacklist = set()
     target = num_executors
     relaunches = 0
@@ -350,7 +373,8 @@ def run_ladder(
         try:
             cluster = TFCluster.run(
                 sc, map_fun, tf_args, target,
-                blacklist=sorted(blacklist) or None, **run_kwargs
+                blacklist=sorted(blacklist) or None, registry=registry,
+                **run_kwargs
             )
         except Exception as e:
             failure = e
@@ -416,10 +440,13 @@ def run_ladder(
             for eid in recovered:
                 blacklist.discard(eid)
                 ledger.clear(eid)
+                registry.forgive(eid)
             if recovered:
                 logger.info("regrow: executors %s passed re-probe; unblacklisted",
                             recovered)
         blacklist.update(ledger.suspects())
+        for eid in sorted(blacklist):
+            registry.blacklist(eid, reason=event.kind)
 
         # shrink to surviving capacity, then preflight the actual candidates;
         # gate failures shrink further (and can trip the min_workers floor)
@@ -443,6 +470,7 @@ def run_ladder(
                 break
             for eid, reason in sorted(bad.items()):
                 logger.warning("blacklisting executor %s: %s", eid, reason)
+                registry.blacklist(eid, reason="preflight: {}".format(reason))
             blacklist.update(bad)
         if new_target < target:
             obs.counter(

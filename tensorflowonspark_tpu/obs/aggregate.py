@@ -47,6 +47,11 @@ logger = logging.getLogger(__name__)
 CHANNEL_KEY = "obs_snapshot"
 #: channel key accumulated by short-lived feeder/launch tasks
 FEEDER_KEY = "obs_feeder"
+#: channel key overwritten by an elected heartbeat aggregator's private
+#: registry (registry.HeartbeatAggregator) — overwrite semantics like
+#: CHANNEL_KEY, but a separate lane because the aggregator thread outlives
+#: the launch task and must not double-count the child's snapshot
+AGGREGATOR_KEY = "obs_aggregator"
 
 #: seconds between child snapshot publications
 PUBLISH_INTERVAL = float(os.environ.get("TOS_OBS_PUBLISH_INTERVAL", "2"))
@@ -124,8 +129,9 @@ def accumulate_to_channel(mgr, registry, key=FEEDER_KEY):
     mgr.set(key, json.dumps(merged))
 
 
-def read_channel_snapshots(mgr, keys=(CHANNEL_KEY, FEEDER_KEY)):
-    """All snapshots one executor channel holds (child + feeder lanes)."""
+def read_channel_snapshots(mgr, keys=(CHANNEL_KEY, FEEDER_KEY, AGGREGATOR_KEY)):
+    """All snapshots one executor channel holds (child + feeder +
+    heartbeat-aggregator lanes)."""
     snaps = []
     for key in keys:
         try:

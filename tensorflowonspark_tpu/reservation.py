@@ -209,13 +209,20 @@ class Server:
     ``blacklist`` is a set of executor ids whose registrations are refused —
     the recovery ladder excludes known-bad hosts this way, and a refused
     executor fails fast instead of silently joining the wrong cluster.
+
+    ``registry`` is an optional
+    :class:`~tensorflowonspark_tpu.registry.MembershipRegistry`: when given,
+    it becomes the membership truth — its blacklist is consulted alongside
+    (union with) the static ``blacklist`` set, and every accepted REG grants
+    the executor a lease via ``registry.join``.
     """
 
-    def __init__(self, count, expected_ids=None, blacklist=None):
+    def __init__(self, count, expected_ids=None, blacklist=None, registry=None):
         if count <= 0:
             raise ValueError("reservation count must be positive")
         self.reservations = Reservations(count, expected_ids=expected_ids)
         self.blacklist = frozenset(blacklist or ())
+        self.registry = registry
         self._stop_requested = threading.Event()
         self._shutdown = threading.Event()
         self._sock = None
@@ -365,7 +372,11 @@ class Server:
                 raise OSError("chaos: dropped registration")
             data = msg.get("data", {})
             eid = data.get("executor_id") if isinstance(data, dict) else None
-            if eid is not None and eid in self.blacklist:
+            refused = eid is not None and (
+                eid in self.blacklist
+                or (self.registry is not None and self.registry.is_blacklisted(eid))
+            )
+            if refused:
                 obs.counter(
                     "reservation_blacklist_rejections_total",
                     help="REG refused because the executor is blacklisted",
@@ -376,6 +387,17 @@ class Server:
                 )
                 return
             self.reservations.add(data)
+            if self.registry is not None and eid is not None:
+                try:
+                    self.registry.join(
+                        eid,
+                        job_name=data.get("job_name"),
+                        task_index=data.get("task_index"),
+                    )
+                except Exception as e:
+                    # a fenced/failed journal must not take down assembly:
+                    # the lease is advisory until the watchdog reads it
+                    logger.warning("registry join for executor %s failed: %s", eid, e)
             obs.counter(
                 "reservation_registrations_total",
                 help="REG messages accepted (retries re-register idempotently)",
@@ -395,12 +417,30 @@ class Server:
             msock.send({"type": "ERROR", "data": "unknown message type {!r}".format(kind)})
 
 
+#: env var: seconds a restarting driver is given to re-bind its rendezvous
+#: socket before connection-refused executors give up
+ENV_RESTART_WINDOW = "TOS_DRIVER_RESTART_WINDOW"
+
+#: default driver-restart grace window (seconds)
+DEFAULT_RESTART_WINDOW = 15.0
+
+
 class Client:
     """Executor-side client for the reservation server.
 
     Opens one connection per request with bounded retries, because executors
     may race the server's startup and Spark may retry tasks (reference kept a
     connection but reconnect-retried ×3, reservation.py:221-246).
+
+    Connection-refused is special-cased: nothing is listening on the
+    rendezvous port, which during a driver restart is a *transient* state —
+    the new driver re-binds (``TOS_TPU_SERVER_PORT`` pins the port precisely
+    so this works) within the restart window. Rather than failing the
+    executor on the first refusal, refusals are retried under a dedicated
+    deadline-bounded policy (``restart_window`` seconds, env
+    ``TOS_DRIVER_RESTART_WINDOW``); the error that finally surfaces names
+    the rendezvous address and the elapsed retry budget so the operator can
+    tell "driver never came back" from "wrong address".
     """
 
     RETRIES = 3
@@ -409,15 +449,32 @@ class Client:
     #: jittered so a fleet of racing executors doesn't reconnect in lockstep)
     BACKOFF = resilience.Backoff(base=1.0, factor=2.0, max_delay=5.0, jitter=0.5)
 
-    def __init__(self, server_addr, timeout=30):
+    def __init__(self, server_addr, timeout=30, restart_window=None, backoff=None):
         self.server_addr = (server_addr[0], int(server_addr[1]))
         self.timeout = timeout
+        if restart_window is None:
+            restart_window = float(
+                os.environ.get(ENV_RESTART_WINDOW, str(DEFAULT_RESTART_WINDOW))
+            )
+        self.restart_window = restart_window
+        backoff = backoff if backoff is not None else self.BACKOFF
         self._policy = resilience.RetryPolicy(
             max_attempts=self.RETRIES,
-            backoff=self.BACKOFF,
+            backoff=backoff,
             retry_on=(OSError, ReservationError),
             on_retry=self._on_retry,
             name="reservation-client",
+        )
+        # connection-refused during a driver restart: retry until the window
+        # closes, not until an attempt count runs out — the deadline is the
+        # budget (attempt cap is just a runaway guard)
+        self._restart_policy = resilience.RetryPolicy(
+            max_attempts=256,
+            backoff=backoff,
+            retry_on=(ConnectionRefusedError,),
+            timeout=self.restart_window,
+            on_retry=self._on_restart_retry,
+            name="reservation-restart-window",
         )
 
     @staticmethod
@@ -428,6 +485,17 @@ class Client:
         ).inc()
         logger.debug("reservation request attempt %d failed (%s); retrying in %.1fs",
                      attempt + 1, exc, delay)
+
+    @staticmethod
+    def _on_restart_retry(attempt, exc, delay):
+        obs.counter(
+            "reservation_restart_retries_total",
+            help="connection-refused retries inside the driver-restart window",
+        ).inc()
+        logger.info(
+            "rendezvous refused connection (attempt %d) — assuming driver "
+            "restart, retrying in %.1fs", attempt + 1, delay,
+        )
 
     def _request_once(self, msg):
         if chaos.active and chaos.fire("reservation.client_reset"):
@@ -445,6 +513,21 @@ class Client:
     def _request(self, msg):
         try:
             return self._policy.call(self._request_once, msg)
+        except ConnectionRefusedError:
+            # nothing listening: plausibly a driver restart in progress.
+            # Keep knocking until the restart window closes.
+            started = time.monotonic()
+            try:
+                return self._restart_policy.call(self._request_once, msg)
+            except (OSError, ReservationError, resilience.DeadlineExceeded) as e:
+                elapsed = time.monotonic() - started
+                raise ReservationError(
+                    "could not reach reservation server at {}:{} after {:.1f}s of "
+                    "connection-refused retries (driver restart window {:.0f}s): {}".format(
+                        self.server_addr[0], self.server_addr[1],
+                        elapsed, self.restart_window, e,
+                    )
+                ) from e
         except (OSError, ReservationError) as e:
             raise ReservationError(
                 "could not reach reservation server at {}: {}".format(self.server_addr, e)

@@ -113,6 +113,60 @@ class Backoff:
         )
 
 
+class Ticker:
+    """A drift-free periodic schedule on the monotonic clock, with jitter.
+
+    Tick *n* is scheduled at ``t0 + n * interval + u_n``, where ``u_n`` is
+    uniform in ``± jitter * interval`` (re-drawn per tick). Anchoring every
+    tick to ``t0`` instead of "now + interval" keeps the long-run rate exact
+    even when tick bodies take time — and the per-tick jitter keeps a fleet
+    of N tickers started in the same assembly barrier from firing in
+    lockstep (the synchronized-burst problem a heartbeat aggregation tree
+    would otherwise amplify). Seedable for deterministic tests; overruns
+    skip the sleep rather than sleeping negative.
+    """
+
+    def __init__(self, interval, jitter=0.0, seed=None,
+                 clock=time.monotonic, sleep=time.sleep):
+        if interval <= 0:
+            raise ValueError("interval must be > 0")
+        if not 0.0 <= jitter < 1.0:
+            raise ValueError("jitter must be in [0, 1)")
+        self.interval = float(interval)
+        self.jitter = float(jitter)
+        self.seed = seed
+        self._clock = clock
+        self._sleep = sleep
+
+    def ticks(self, deadline=None):
+        """Yield tick indices ``0, 1, 2, ...``, sleeping until each tick's
+        scheduled time between yields. The first tick fires immediately.
+        With a :class:`Deadline` the generator stops once the budget is
+        spent; without one it is infinite."""
+        rng = random.Random(self.seed)
+        t0 = self._clock()
+        n = 0
+        while True:
+            yield n
+            n += 1
+            if deadline is not None and deadline.expired():
+                return
+            offset = rng.uniform(-self.jitter, self.jitter) * self.interval if self.jitter else 0.0
+            due = t0 + n * self.interval + offset
+            delay = due - self._clock()
+            if deadline is not None:
+                if deadline.expired():
+                    return
+                delay = deadline.clamp(delay)
+            if delay > 0:
+                self._sleep(delay)
+
+    def __repr__(self):
+        return "Ticker(interval={}, jitter={}, seed={})".format(
+            self.interval, self.jitter, self.seed
+        )
+
+
 class Deadline:
     """An absolute point on the monotonic clock shared across attempts.
 

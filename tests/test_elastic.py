@@ -64,6 +64,26 @@ class TestClassifyFailure:
         assert event.kind == "node_error"
         assert event.kind not in elastic.LOSS_KINDS
 
+    def test_lease_expired_attributed_via_executor_tag(self):
+        # ISSUE 11: the registry watchdog names the executor inline, so
+        # attribution no longer depends on a role_map being threaded through
+        exc = RuntimeError(
+            "cluster failed: node worker:1 stopped heartbeating: lease "
+            "expired after 31s without renewal (executor 4)"
+        )
+        event = elastic.classify_failure(exc)
+        assert event.kind == "lease_expired"
+        assert event.executor_ids == [4]
+        assert event.kind in elastic.LOSS_KINDS
+
+    def test_lease_expired_counts_toward_suspects(self):
+        ledger = elastic.FailureLedger(max_restarts=8, blacklist_after=2)
+        event = elastic.FailureEvent("lease_expired", [3], "lease expired (executor 3)")
+        ledger.record(event)
+        assert ledger.suspects() == []
+        ledger.record(event)
+        assert ledger.suspects() == [3]
+
     def test_feed_timeout(self):
         exc = RuntimeError("feed timeout: queue 'input' still has 3 unconsumed items")
         assert elastic.classify_failure(exc).kind == "feed_timeout"

@@ -7,7 +7,7 @@ from unittest import mock
 
 import pytest
 
-from tensorflowonspark_tpu import reservation
+from tensorflowonspark_tpu import reservation, resilience
 
 
 class TestReservations:
@@ -101,6 +101,63 @@ class TestServerClient:
             assert sorted(r["executor_id"] for r in got) == list(range(n))
         finally:
             server.stop()
+
+
+class TestDriverRestartWindow:
+    """ISSUE 11 satellite: connection-refused during a driver restart is
+    retried under a deadline-bounded policy instead of failing fast."""
+
+    FAST = resilience.Backoff(base=0.05, factor=1.0, max_delay=0.05, jitter=0.0)
+
+    @staticmethod
+    def _free_port():
+        import socket as _socket
+
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def test_client_rides_out_a_driver_restart(self):
+        port = self._free_port()  # nothing listening yet: connection refused
+        client = reservation.Client(
+            ("127.0.0.1", port), restart_window=20, backoff=self.FAST
+        )
+        result = {}
+
+        def register():
+            client.register({"executor_id": 0})
+            result["reservations"] = client.await_reservations(
+                timeout=20, poll_interval=0.05
+            )
+
+        t = threading.Thread(target=register, daemon=True)
+        t.start()
+        time.sleep(0.4)  # let the client knock on the closed port a few times
+        with mock.patch.dict(os.environ, {reservation.ENV_SERVER_PORT: str(port)}):
+            server = reservation.Server(1)
+            server.start()  # the "restarted driver" comes back on the same addr
+        try:
+            t.join(timeout=20)
+            assert not t.is_alive()
+            assert result["reservations"][0]["executor_id"] == 0
+        finally:
+            server.stop()
+
+    def test_window_exhaustion_names_address_and_budget(self):
+        port = self._free_port()
+        client = reservation.Client(
+            ("127.0.0.1", port), restart_window=0.3, backoff=self.FAST
+        )
+        started = time.monotonic()
+        with pytest.raises(reservation.ReservationError) as exc_info:
+            client.register({"executor_id": 0})
+        msg = str(exc_info.value)
+        assert "127.0.0.1:{}".format(port) in msg
+        assert "connection-refused retries" in msg
+        assert "driver restart window 0s" in msg or "restart window" in msg
+        assert time.monotonic() - started < 10  # bounded by the window, not RETRIES*backoff
 
 
 class TestIdempotentRegister:

@@ -42,6 +42,81 @@ class TestBackoff:
             resilience.Backoff(jitter=2.0)
 
 
+class TestTicker:
+    """ISSUE 11 satellite: the heartbeat schedule is anchored to the
+    monotonic clock (drift-free) and jittered (no lockstep fleets)."""
+
+    def _run(self, ticker, n_ticks, work=0.0):
+        """Drive a ticker on a fake clock; returns the sleep durations."""
+        now = [100.0]
+        sleeps = []
+
+        def clock():
+            return now[0]
+
+        def sleep(s):
+            sleeps.append(s)
+            now[0] += s
+
+        t = resilience.Ticker(
+            ticker.interval, jitter=ticker.jitter, seed=ticker.seed,
+            clock=clock, sleep=sleep,
+        )
+        for i in t.ticks():
+            now[0] += work  # simulate the tick body taking time
+            if i >= n_ticks - 1:
+                break
+        return sleeps
+
+    def test_drift_free_schedule_without_jitter(self):
+        spec = resilience.Ticker(2.0, jitter=0.0)
+        assert self._run(spec, 4) == [2.0, 2.0, 2.0]
+
+    def test_tick_body_time_is_absorbed_not_accumulated(self):
+        """Each tick is scheduled at t0 + n*interval: a 0.5s body shortens
+        the sleep instead of pushing every later tick back (the Backoff
+        ticker it replaces slept a full interval after the body)."""
+        spec = resilience.Ticker(2.0, jitter=0.0)
+        sleeps = self._run(spec, 4, work=0.5)
+        assert sleeps == [1.5, 1.5, 1.5]
+
+    def test_overrun_skips_sleep(self):
+        spec = resilience.Ticker(1.0, jitter=0.0)
+        sleeps = self._run(spec, 3, work=5.0)
+        assert sleeps == []  # behind schedule: never sleeps negative
+
+    def test_jitter_bounds_and_reproducibility(self):
+        spec = resilience.Ticker(2.0, jitter=0.25, seed=11)
+        sleeps = self._run(spec, 20)
+        # each due time is n*interval ± jitter*interval around the anchor
+        assert all(0.0 <= s <= 3.0 for s in sleeps)
+        assert any(s != 2.0 for s in sleeps)
+        assert sleeps == self._run(spec, 20)  # seeded: reproducible
+        other = resilience.Ticker(2.0, jitter=0.25, seed=12)
+        assert sleeps != self._run(other, 20)  # different seed: different phase
+
+    def test_deadline_stops_the_generator(self):
+        now = [0.0]
+        clock = lambda: now[0]
+
+        def sleep(s):
+            now[0] += s
+
+        t = resilience.Ticker(1.0, jitter=0.0, clock=clock, sleep=sleep)
+        d = resilience.Deadline(3.5, clock=clock)
+        ticks = list(t.ticks(deadline=d))
+        # the last sleep is clamped to the deadline edge and that tick still
+        # fires — same contract as Backoff.attempts — then the generator ends
+        assert ticks == [0, 1, 2, 3, 4]
+        assert now[0] == pytest.approx(3.5)
+
+    def test_rejects_bad_parameters(self):
+        with pytest.raises(ValueError):
+            resilience.Ticker(0.0)
+        with pytest.raises(ValueError):
+            resilience.Ticker(1.0, jitter=1.0)
+
+
 class TestDeadline:
     def test_expiry_with_fake_clock(self):
         now = [0.0]

@@ -7,7 +7,7 @@ import time
 
 import pytest
 
-from tensorflowonspark_tpu import TFCluster
+from tensorflowonspark_tpu import TFCluster, elastic
 from tensorflowonspark_tpu.TFCluster import InputMode
 from tensorflowonspark_tpu.backends.local import LocalSparkContext
 
@@ -85,6 +85,44 @@ def test_watchdog_detects_silent_child_death(monkeypatch):
         err = _wait_for_error(cluster, within_secs=90)
         assert err is not None and "stopped heartbeating" in err
         with pytest.raises(RuntimeError, match="stopped heartbeating"):
+            cluster.shutdown(timeout=60)
+    finally:
+        sc.stop()
+
+
+@pytest.mark.slow
+def test_lease_expiry_names_the_executor_for_the_ledger(monkeypatch):
+    """ISSUE 11 satellite: a node that stops renewing its lease surfaces as
+    a first-class ``lease_expired`` event carrying the executor id inline —
+    so ``FailureLedger.suspects()`` attributes it without a role_map — and
+    the registry's lease metrics land in the merged ``cluster.metrics()``."""
+    monkeypatch.setenv("TOS_MONITOR_INTERVAL", "1")
+    monkeypatch.setenv("TOS_HEARTBEAT_STALE", "6")
+    sc = LocalSparkContext(num_executors=2, task_timeout=240)
+    try:
+        cluster = TFCluster.run(
+            sc, fn_sigkill_self, {"victim": 1}, num_executors=2,
+            input_mode=InputMode.SPARK, master_node=None,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
+        )
+        err = _wait_for_error(cluster, within_secs=90)
+        assert err is not None and "lease expired" in err
+
+        event = elastic.classify_failure(RuntimeError(err))
+        assert event.kind == "lease_expired"
+        assert event.executor_ids == [1]
+        assert event.kind in elastic.LOSS_KINDS
+
+        ledger = elastic.FailureLedger(max_restarts=8, blacklist_after=2)
+        ledger.record(event)
+        ledger.record(event)
+        assert ledger.suspects() == [1]
+
+        snap = cluster.metrics()
+        assert snap["counters"]["registry_lease_expirations_total"]["value"] >= 1
+        assert snap["gauges"]["registry_epoch"]["value"] >= 1
+
+        with pytest.raises(RuntimeError, match="lease expired"):
             cluster.shutdown(timeout=60)
     finally:
         sc.stop()
