@@ -203,34 +203,60 @@ class TFCluster:
                 window_state[agg_eid] = (summary.get("window"), now)
             elif now - prev[1] > window_horizon:
                 return set(), {}  # aggregator stopped publishing
+            # members the summary carries nothing for are NOT covered: the
+            # aggregator could not reach their channel (or the child has not
+            # beaten yet), and renewing here would keep a dead executor's
+            # lease alive forever. They fall through to the direct-poll
+            # path, where an unreachable channel stops renewals and the
+            # lease expires after the TTL.
+            statuses, beats, flagged = membership.window_coverage(
+                summary, [e for e in tree[agg_eid] if e in rows_by_eid]
+            )
             covered, problems = set(), {}
-            statuses = summary.get("status") or {}
-            beats = summary.get("beats") or {}
-            flagged = set(summary.get("errors") or [])
-            for eid in tree[agg_eid]:
-                if eid not in rows_by_eid:
+            for eid in flagged:
+                try:
+                    problem = _node_error(eid)
+                except Exception:
+                    continue
+                if problem is not None:
+                    problems[eid] = problem
+            for eid, status in statuses.items():
+                if eid in problems:
                     continue
                 covered.add(eid)
-                if eid in flagged:
-                    try:
-                        problem = _node_error(eid)
-                    except Exception:
-                        problem = None
-                    if problem is not None:
-                        problems[eid] = problem
-                        continue
-                if str(eid) in statuses:
-                    self.registry.leave(eid, reason=str(statuses[str(eid)]))
+                self.registry.leave(eid, reason=str(status))
+            for eid, beat in beats.items():
+                if eid in problems:
                     continue
-                self.registry.renew(eid, beat=beats.get(str(eid)))
+                covered.add(eid)
+                self.registry.renew(eid, beat=beat)
             return covered, problems
+
+        registry_errors = obs_registry.counter(
+            "watchdog_registry_errors_total",
+            help="watchdog registry operations that raised (journal I/O, fencing)",
+        )
 
         def _monitor():
             reported = set()
             poll_errors_logged = set()  # log an unreachable channel once per node
+            registry_error_logged = [False]  # log a registry I/O failure once
+
+            def _registry_failed(e, what):
+                """A registry operation raised inside the watchdog loop: count
+                it, log once, and keep the thread alive — an unwritable journal
+                dir must not silently end all failure detection."""
+                registry_errors.inc()
+                if not registry_error_logged[0]:
+                    registry_error_logged[0] = True
+                    logger.warning("watchdog: %s failed: %s", what, e)
+
             while not stop.wait(interval):
                 if chaos.active and chaos.fire("control.driver_crash"):
-                    self._simulate_driver_restart()
+                    try:
+                        self._simulate_driver_restart()
+                    except Exception as e:
+                        _registry_failed(e, "driver-restart recovery")
                 covered, problems = set(), {}
                 for agg_eid in tree:
                     try:
@@ -262,7 +288,21 @@ class TFCluster:
                     poll_errors_logged.discard(eid)
                     if problem:
                         problems[eid] = problem
-                for eid, age in self.registry.expire_stale():
+                try:
+                    expired = self.registry.expire_stale()
+                except membership.StaleEpochError as e:
+                    # a newer driver generation fenced this registry: every
+                    # further durable write will refuse, so surface the
+                    # takeover to the job instead of dying silently
+                    expired = []
+                    _registry_failed(e, "lease expiry")
+                    self.tf_status.setdefault(
+                        "error", "watchdog registry fenced: {}".format(e)
+                    )
+                except Exception as e:
+                    expired = []
+                    _registry_failed(e, "lease expiry")
+                for eid, age in expired:
                     if eid in reported or eid in problems:
                         continue
                     row = rows_by_eid.get(eid)

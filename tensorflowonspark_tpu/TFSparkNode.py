@@ -25,6 +25,7 @@ local backend both ship them to executors by serialization).
 import logging
 import os
 import signal
+import threading
 import time
 import traceback
 
@@ -65,6 +66,15 @@ logger = logging.getLogger(__name__)
 #: that land on this executor later reuse the handle — the reference's
 #: module-global manager singleton (TFSparkNode.py:97-123).
 _live_channels = {}
+
+#: Executor-process-global registry of running heartbeat aggregators, keyed by
+#: executor id. The aggregator thread outlives the launch task alongside its
+#: channel; a Spark task retry (or a relaunch generation) on the same executor
+#: must stop the previous one before electing anew — two aggregators publishing
+#: independently-numbered windows on one channel would make the driver's
+#: window-freshness check flap.
+_live_aggregators = {}
+_live_aggregators_lock = threading.Lock()
 
 
 class TFNodeContext:
@@ -584,10 +594,20 @@ class _NodeLaunchTask:
         spark mode via ``_live_channels``), publishing per-window beat
         summaries on this node's own channel; the driver's watchdog reads
         those instead of polling every member directly. Failure to start is
-        non-fatal — the driver falls back to direct polls."""
+        non-fatal — the driver falls back to direct polls.
+
+        Idempotent per executor process: the aggregator thread also outlives
+        the launch task, so a Spark task retry (or a relaunch generation with
+        a different tree) first stops the previous aggregator — otherwise two
+        threads would interleave independently-numbered windows under
+        ``WINDOW_KEY`` and the driver's freshness check would flap."""
         from tensorflowonspark_tpu import registry as registry_mod
 
         try:
+            with _live_aggregators_lock:
+                prev = _live_aggregators.pop(executor_id, None)
+            if prev is not None:
+                prev.stop()
             if not registry_mod.aggregation_enabled(len(cluster_info)):
                 return
             tree = registry_mod.plan_aggregation_tree(cluster_info)
@@ -602,6 +622,8 @@ class _NodeLaunchTask:
                 obs_enabled=bool(meta.get("obs", True)),
             )
             agg.start()
+            with _live_aggregators_lock:
+                _live_aggregators[executor_id] = agg
             logger.info(
                 "executor %d aggregating heartbeats for members %s",
                 executor_id, members,

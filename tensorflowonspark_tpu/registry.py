@@ -135,6 +135,29 @@ def aggregation_enabled(num_nodes):
     return num_nodes >= int(os.environ.get("TOS_HEARTBEAT_AGG_MIN", "2"))
 
 
+def window_coverage(summary, member_eids):
+    """Which of ``member_eids`` one aggregator window summary actually covers.
+
+    Returns ``(statuses, beats, flagged)``, the first two keyed by int
+    executor id. A member appearing in NONE of them was unreachable from the
+    aggregator (executor process gone) or has not produced a beat yet: it is
+    NOT covered, and the driver must fall back to direct-polling it — a
+    lease renewal inferred from a summary that carries no data for the
+    member would keep a dead executor alive forever.
+    """
+    statuses_raw = summary.get("status") or {}
+    beats_raw = summary.get("beats") or {}
+    flagged = set(summary.get("errors") or [])
+    statuses, beats = {}, {}
+    for eid in member_eids:
+        seid = str(eid)
+        if seid in statuses_raw:
+            statuses[eid] = statuses_raw[seid]
+        elif seid in beats_raw:
+            beats[eid] = beats_raw[seid]
+    return statuses, beats, flagged & set(member_eids)
+
+
 def plan_aggregation_tree(rows):
     """Elect aggregators: ``{aggregator_executor_id: [member ids...]}``.
 
@@ -366,9 +389,22 @@ class MembershipRegistry:
                 if age > self.ttl:
                     m["state"] = "expired"
                     expired.append((eid, age))
+            for eid, age in expired:
+                try:
                     self._journal_locked(
                         {"op": "expire", "eid": eid, "t": now, "age": age}
                     )
+                except StaleEpochError:
+                    raise
+                except Exception as e:
+                    # journal durability failed (disk full, unwritable dir):
+                    # the in-memory expiry stands and is still RETURNED —
+                    # failure detection must not depend on the disk. A later
+                    # recovery re-derives the expiry from the lease age.
+                    logger.warning(
+                        "registry: could not journal expiry of %s: %s", eid, e
+                    )
+                    break
         if expired:
             obs.counter(
                 "registry_lease_expirations_total",
@@ -447,6 +483,22 @@ class MembershipRegistry:
         """One journal line: crc32-of-payload, space, payload, newline."""
         return "{:08x} {}\n".format(zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF, payload)
 
+    @staticmethod
+    def _fsync_dir(path):
+        """Make a rename in ``path`` durable: fsync the directory entry the
+        same way file contents are fsynced (best-effort — some filesystems
+        refuse directory fds)."""
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass
+        finally:
+            os.close(fd)
+
     def _state_locked(self):
         return {
             "epoch": self._epoch,
@@ -487,6 +539,10 @@ class MembershipRegistry:
             f.flush()
             os.fsync(f.fileno())
         os.rename(tmp, mpath)
+        # make the rename itself durable before the truncation below can be:
+        # otherwise a power loss may persist an empty journal next to the
+        # OLD manifest, silently losing the folded-in transitions
+        self._fsync_dir(self.journal_dir)
         try:
             self._manifest_stat = self._stat_manifest()
         except OSError:
@@ -802,6 +858,8 @@ class HeartbeatAggregator:
                 {"window": n, "ts": time.time(), "beats": beats,
                  "status": status, "errors": errors}
             )
+            if self._stop.is_set():
+                return  # stopped mid-gather: a replacement owns WINDOW_KEY now
             try:
                 self._mgr.set(WINDOW_KEY, summary)
                 windows.inc()

@@ -13,6 +13,7 @@ from tensorflowonspark_tpu import TFCluster, chaos
 from tensorflowonspark_tpu import registry as membership
 from tensorflowonspark_tpu.TFCluster import InputMode
 from tensorflowonspark_tpu.backends.local import LocalSparkContext
+from tensorflowonspark_tpu.obs import registry as obs_registry
 
 CPU_ENV = {"JAX_PLATFORMS": "cpu"}
 
@@ -109,6 +110,10 @@ def test_lease_delay_is_benign(tmp_path, monkeypatch):
         "control.lease_delay", probability=0.5, max_count=None, delay_s=0.01
     )
     chaos.install(plan)
+    # the expiration counter lives in the process-global obs registry, so
+    # earlier tests in the same process may already have bumped it: assert
+    # the DELTA over this cluster's lifetime, not the absolute value
+    expirations_before = obs_registry.counter("registry_lease_expirations_total").value
     sc = LocalSparkContext(num_executors=2, task_timeout=240)
     try:
         cluster = TFCluster.run(
@@ -120,7 +125,10 @@ def test_lease_delay_is_benign(tmp_path, monkeypatch):
         time.sleep(5)  # a few watchdog ticks under injected renewal latency
         snap = cluster.metrics()
         assert cluster.tf_status.get("error") is None
-        assert snap["counters"].get("registry_lease_expirations_total") is None
+        expirations = (snap["counters"].get("registry_lease_expirations_total") or {}).get(
+            "value", 0
+        )
+        assert expirations == expirations_before
         assert snap["gauges"]["registry_leases_active"]["value"] == 2
         cluster.shutdown(timeout=120)
     finally:

@@ -63,6 +63,26 @@ class TestLeaseLifecycle:
         assert age > 10
         assert reg.live_members() == [0]
 
+    def test_expiry_survives_journal_io_failure(self, tmp_path, monkeypatch):
+        """Failure detection must not depend on the disk: a journal append
+        that raises (disk full, unwritable dir) still returns the in-memory
+        expiries — otherwise the members flip to ``expired`` state but are
+        never reported, and the loss goes permanently unnoticed."""
+        clk = FakeClock()
+        reg = registry.MembershipRegistry(ttl=10, journal_dir=str(tmp_path), clock=clk)
+        reg.begin_generation()
+        reg.join(0)
+        reg.renew(0, beat=1)
+        clk.advance(11)
+
+        def boom(record):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(reg, "_journal_locked", boom)
+        expired = reg.expire_stale()
+        assert [eid for eid, _ in expired] == [0]
+        assert reg.live_members() == []
+
     def test_member_that_never_beat_is_exempt(self):
         """Slow child startup is the launch timeout's concern, not a lease
         violation (historical watchdog parity)."""
@@ -299,6 +319,41 @@ class TestAggregationTree:
 
     def test_empty_tree(self):
         assert registry.plan_aggregation_tree([]) == {}
+
+    def test_window_coverage_splits_members(self):
+        summary = {
+            "window": 7,
+            "beats": {"0": 5, "2": 9},
+            "status": {"1": "done"},
+            "errors": [2],
+        }
+        statuses, beats, flagged = registry.window_coverage(summary, [0, 1, 2])
+        assert statuses == {1: "done"}
+        assert beats == {0: 5, 2: 9}
+        assert flagged == {2}
+
+    def test_window_coverage_excludes_members_absent_from_summary(self):
+        """An executor that died entirely (process/machine gone) appears in
+        neither beats, status, nor errors — the aggregator could not reach
+        its channel. It must NOT count as covered: if the driver renewed its
+        lease anyway (a beat-less renew is unconditional), the dead
+        executor's lease would never expire and the failure would never
+        surface. Uncovered members fall back to direct polls, where the
+        unreachable channel stops renewals."""
+        summary = {"window": 3, "beats": {"0": 4}, "status": {}, "errors": []}
+        statuses, beats, flagged = registry.window_coverage(summary, [0, 1])
+        assert beats == {0: 4}
+        assert statuses == {}
+        assert flagged == set()
+        assert 1 not in statuses and 1 not in beats  # → direct-poll path
+
+    def test_window_coverage_ignores_non_members(self):
+        # a summary may carry rows for executors no longer in the tree
+        # (stale window from a previous generation): only tree members count
+        summary = {"window": 1, "beats": {"0": 1, "9": 8}, "errors": [9]}
+        statuses, beats, flagged = registry.window_coverage(summary, [0, 1])
+        assert beats == {0: 1}
+        assert flagged == set()
 
     def test_enablement_knob(self, monkeypatch):
         monkeypatch.delenv("TOS_HEARTBEAT_AGG", raising=False)
