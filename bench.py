@@ -92,20 +92,27 @@ def least_implausible_pair(nc_rates, tr_rates):
     return min(zip(nc_rates, tr_rates), key=lambda p: abs(math.log(p[1] / p[0])))
 
 
-def confidence_fields(pairs_recorded, pairs_requested, invalid_pairs=0):
+def confidence_fields(pairs_recorded, pairs_requested, invalid_pairs=0,
+                      budget_exhausted=False):
     """Annotation for pair-budgeted results: how many train/no-compute pairs
-    actually landed out of how many were requested, how many were discarded
-    as invalid (ratio outside the symmetric :data:`MAX_VALID_PAIR_RATIO`
-    band), and
+    actually landed out of how many were requested
+    (``pairs``/``pairs_requested``), how many of those survived validity
+    filtering (``pairs_completed`` — the count the median actually rests
+    on), how many were discarded as invalid (ratio outside the symmetric
+    :data:`MAX_VALID_PAIR_RATIO` band), whether the time budget — not the
+    rep count — ended the run (``budget_exhausted``), and
     ``low_confidence: true`` when the median rests on fewer usable samples
     than the operator asked for (budget cut the run short, or pairs were
     discarded)."""
     fields = {
         "pairs": int(pairs_recorded),
         "pairs_requested": int(pairs_requested),
+        "pairs_completed": int(pairs_recorded) - int(invalid_pairs),
     }
     if invalid_pairs:
         fields["invalid_pairs"] = int(invalid_pairs)
+    if budget_exhausted:
+        fields["budget_exhausted"] = True
     if pairs_recorded - invalid_pairs < pairs_requested:
         fields["low_confidence"] = True
     return fields
@@ -443,6 +450,26 @@ def bench_resnet(tiny, real_data):
                 float(np.asarray(jax.device_get(metrics["loss"])))
                 return d * per_dispatch_imgs / (time.perf_counter() - t0)
 
+            # one WARM-UP pair, measured and discarded before any recorded
+            # pair ever reaches validity filtering: the first pair reads
+            # through cold page cache, unwarmed branch paths and an unprobed
+            # link mood, so historically it either dragged the median or
+            # burned one of the precious valid-pair slots as an "invalid"
+            # discard. Measuring it (instead of just running it blind) buys
+            # a current rate estimate for block sizing.
+            d0 = min_dispatches
+            warm_nc = _no_compute_block(d0)
+            warm_tr = _train_block(d0)
+            print(
+                "warm-up pair (measured, discarded): train {} | input-path "
+                "{} img/s | ratio {:.3f}".format(
+                    round(warm_tr / n_chips, 1), round(warm_nc / n_chips, 1),
+                    warm_tr / warm_nc,
+                ),
+                file=sys.stderr,
+            )
+            rate_est = warm_nc
+            budget_exhausted = False
             for pair in range(reps):
                 remaining = budget - (time.perf_counter() - t_bench)
                 # a pair costs TWO blocks at roughly the current rate; once
@@ -450,6 +477,7 @@ def bench_resnet(tiny, real_data):
                 # budget on a crawling link
                 min_pair_secs = 2 * (min_dispatches + 1) * per_dispatch_imgs / rate_est
                 if pair > 0 and remaining < 1.5 * min_pair_secs:
+                    budget_exhausted = True
                     print(
                         "budget exhausted after {} pair(s); stopping early".format(pair),
                         file=sys.stderr,
@@ -502,7 +530,10 @@ def bench_resnet(tiny, real_data):
             value = statistics.median([tr for _nc, tr in valid]) / n_chips
             ratio_spread = (min(ratios), max(ratios))
             link_ceiling = statistics.median([nc for nc, _tr in valid]) / n_chips
-            conf = confidence_fields(len(nc_rates), reps, invalid_pairs=len(invalid))
+            conf = confidence_fields(
+                len(nc_rates), reps, invalid_pairs=len(invalid),
+                budget_exhausted=budget_exhausted,
+            )
         else:
             conf = {}
             t0 = time.perf_counter()
@@ -993,13 +1024,15 @@ def bench_ckpt(tiny):
 
 
 def bench_decode(tiny):
-    """Input-path-only throughput: the thread parse pool vs the multiprocess
-    decode plane on identical ImageNet-schema shards. No model, no device
-    transfers — the drain loop IS the consumer — so the ratio isolates
-    exactly what the decode plane changes: where the JPEG decode runs.
-    ``value`` is the process-plane img/s; ``vs_baseline`` the speedup over
-    the thread pool on this host (expect ~1x on a single-core box — the
-    plane can't beat the GIL without cores to spend)."""
+    """Input-path-only throughput across the decode stack's rungs on
+    identical ImageNet-schema shards: the PIL thread pool (the pre-native
+    baseline), the native-decode thread pool, the multiprocess decode
+    plane, and an epoch-2 warm decoded-slab cache. No model, no device
+    transfers — the drain loop IS the consumer — so each ratio isolates
+    exactly one rung. ``value`` is the native process-plane img/s;
+    ``vs_baseline`` its speedup over the PIL thread pool. On a single-core
+    box the plane itself is ~1x (no cores to spend) — the native decoder
+    and the slab cache are the rungs that still pay there."""
     import shutil
     import statistics
     import sys
@@ -1007,7 +1040,7 @@ def bench_decode(tiny):
 
     import numpy as np
 
-    from tensorflowonspark_tpu import obs, tfrecord
+    from tensorflowonspark_tpu import native_io, obs, tfrecord
     from tensorflowonspark_tpu.data import ImagePipeline, imagenet
 
     batch = int(os.environ.get("BENCH_BATCH", 8 if tiny else 64))
@@ -1030,44 +1063,73 @@ def bench_decode(tiny):
                     w.write(imagenet.encode_example(img, int(rng.integers(0, 1000))))
         parse_fn = imagenet.make_parse_fn(True, image_size=image_size, raw_uint8=True)
 
-        def _leg(decode_workers):
-            pipe = ImagePipeline(
-                tfrecord.list_shards(tmp), parse_fn, batch, epochs=None,
-                num_threads=int(os.environ.get("BENCH_DATA_THREADS", "16")),
-                recycle_buffers=True, decode_workers=decode_workers,
-            )
-            it = iter(pipe)
-            rates = []
-            before = obs.snapshot()["counters"]
-            for _ in range(reps):
-                next(it)  # bootstrap + pool spin-up outside the clock
-                t0 = time.perf_counter()
-                for _ in range(drain):
-                    next(it)
-                rates.append(drain * batch / (time.perf_counter() - t0))
-            after = obs.snapshot()["counters"]
+        def _leg(decode_workers, native=True, slab_cache_dir=None):
+            prev = os.environ.get(native_io.DECODE_ENV_VAR)
+            if not native:
+                os.environ[native_io.DECODE_ENV_VAR] = "0"
+            try:
+                pipe = ImagePipeline(
+                    tfrecord.list_shards(tmp), parse_fn, batch, epochs=None,
+                    num_threads=int(os.environ.get("BENCH_DATA_THREADS", "16")),
+                    recycle_buffers=True, decode_workers=decode_workers,
+                    slab_cache_dir=slab_cache_dir,
+                )
+                it = iter(pipe)
+                rates = []
+                before = obs.snapshot()["counters"]
+                for _ in range(reps):
+                    next(it)  # bootstrap + pool spin-up outside the clock
+                    t0 = time.perf_counter()
+                    for _ in range(drain):
+                        next(it)
+                    rates.append(drain * batch / (time.perf_counter() - t0))
+                after = obs.snapshot()["counters"]
 
-            def _d(name):
-                return after.get(name, {}).get("value", 0.0) - before.get(
-                    name, {}
-                ).get("value", 0.0)
+                def _d(name):
+                    return after.get(name, {}).get("value", 0.0) - before.get(
+                        name, {}
+                    ).get("value", 0.0)
 
-            cls = classify_stalls(
-                _d("data_producer_read_seconds_total"),
-                _d("data_producer_parse_seconds_total"),
-                _d("data_producer_emit_seconds_total"),
-                _d("data_consumer_wait_seconds_total"),
-            )
-            del it  # generator finalizer tears the pipeline down
-            return statistics.median(rates), cls
+                cls = classify_stalls(
+                    _d("data_producer_read_seconds_total"),
+                    _d("data_producer_parse_seconds_total"),
+                    _d("data_producer_emit_seconds_total"),
+                    _d("data_consumer_wait_seconds_total"),
+                )
+                deltas = {
+                    "native_records": int(_d("decode_native_total")),
+                    "cache_hits": int(_d("decode_cache_hits_total")),
+                }
+                del it  # generator finalizer tears the pipeline down
+                return statistics.median(rates), cls, deltas
+            finally:
+                if not native:
+                    if prev is None:
+                        os.environ.pop(native_io.DECODE_ENV_VAR, None)
+                    else:
+                        os.environ[native_io.DECODE_ENV_VAR] = prev
 
-        thread_rate, thread_cls = _leg(0)
-        proc_rate, proc_cls = _leg(workers)
+        pil_rate, pil_cls, _pil_d = _leg(0, native=False)
+        thread_rate, thread_cls, thread_d = _leg(0)
+        proc_rate, proc_cls, proc_d = _leg(workers)
+        # warm the decoded-slab cache with one full epoch (commit at the
+        # epoch boundary), then measure the epoch-2 leg against it
+        cache_dir = os.path.join(tmp, "slab-cache")
+        for _ in ImagePipeline(
+            tfrecord.list_shards(tmp), parse_fn, batch, epochs=1,
+            num_threads=int(os.environ.get("BENCH_DATA_THREADS", "16")),
+            recycle_buffers=True, slab_cache_dir=cache_dir,
+        ):
+            pass
+        cached_rate, cached_cls, cached_d = _leg(0, slab_cache_dir=cache_dir)
         print(
-            "decode-only img/s: thread pool {} | {}-process plane {} "
-            "(classification {} -> {})".format(
-                round(thread_rate, 1), workers, round(proc_rate, 1),
-                thread_cls, proc_cls,
+            "decode-only img/s: PIL thread {} | native thread {} | "
+            "{}-process plane {} | warm slab cache {} (classification "
+            "{} -> {} -> {} -> {}; cache hits {})".format(
+                round(pil_rate, 1), round(thread_rate, 1), workers,
+                round(proc_rate, 1), round(cached_rate, 1),
+                pil_cls, thread_cls, proc_cls, cached_cls,
+                cached_d["cache_hits"],
             ),
             file=sys.stderr,
         )
@@ -1077,9 +1139,25 @@ def bench_decode(tiny):
         "metric": "decode_plane_img_per_sec",
         "value": round(proc_rate, 1),
         "unit": "input-path-only images/sec, {} decode worker processes "
-                "(thread pool: {:.1f} img/s)".format(workers, thread_rate),
-        "vs_baseline": round(proc_rate / thread_rate, 2),
+                "(PIL thread-pool baseline: {:.1f} img/s)".format(workers, pil_rate),
+        "vs_baseline": round(proc_rate / pil_rate, 2),
         "decode_workers": workers,
+        "native_build": native_io.build_info(),
+        "legs": {
+            "thread_pil": {"img_per_sec": round(pil_rate, 1), "classification": pil_cls},
+            "thread_native": {
+                "img_per_sec": round(thread_rate, 1), "classification": thread_cls,
+                "native_records": thread_d["native_records"],
+            },
+            "process_native": {
+                "img_per_sec": round(proc_rate, 1), "classification": proc_cls,
+                "native_records": proc_d["native_records"],
+            },
+            "cached": {
+                "img_per_sec": round(cached_rate, 1), "classification": cached_cls,
+                "cache_hits": cached_d["cache_hits"],
+            },
+        },
         "classification": {"thread": thread_cls, "process": proc_cls},
     }
 

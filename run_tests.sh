@@ -25,8 +25,12 @@
 #                 tosa-report.json and tosa-report.sarif (SARIF 2.1.0 for
 #                 code-scanning upload), print the JSON, and exit
 #   --native-sanitize  rebuild native/tfrecord_io.cc with ASan+UBSan and run
-#                 the native IO / streaming-chunk tests against it (skips
-#                 cleanly when no g++ toolchain is present)
+#                 the native IO / streaming-chunk / JPEG-decode tests against
+#                 it — including the header-fuzz loop (truncated and overlong
+#                 JPEG streams, lying segment lengths) over the in-tree scalar
+#                 decoder, which the sanitize build selects by not defining
+#                 TFR_USE_LIBJPEG (skips cleanly when no g++ toolchain is
+#                 present)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -129,6 +133,8 @@ if [[ "$CHAOS" == "1" ]]; then
     "data.producer_delay":  {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "data.shard_read":      {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "data.decode_kill":     {"probability": 0.05, "max_count": null},
+    "data.cache_tear":      {"probability": 0.05, "max_count": null},
+    "data.readahead_stall": {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "serving.latency":      {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "reservation.slow_accept": {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "control.lease_delay":  {"probability": 0.05, "max_count": null, "delay_s": 0.005},

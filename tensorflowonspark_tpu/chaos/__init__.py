@@ -56,6 +56,15 @@ site                            effect at the injection point
                                 must re-decode the orphaned slots on the
                                 respawned pool without losing or
                                 duplicating a row
+``data.cache_tear``             decoded-slab cache commit publishes a TORN
+                                manifest (truncated half-way, the crash-
+                                between-write-and-fsync shape) — verify-on-
+                                publish must reject the generation and its
+                                records must simply decode again
+``data.readahead_stall``        read-ahead shard reader sleeps ``delay_s``
+                                per chunk, charged into shard-read time so
+                                ``classify_stalls`` sees io_bound and the
+                                ``ReadaheadAutotuner`` must deepen
 ``data.device_link``            autotuned feed sleeps ``delay_s`` inside the
                                 timed region of every host->device transfer
                                 (probes and windows), so injected latency

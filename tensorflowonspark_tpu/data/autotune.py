@@ -62,6 +62,7 @@ metric                              meaning
 ``feed_window_size``                the K the controller currently feeds
 ``feed_recompiles_total``           packed-loop compilations (≤ one/bucket)
 ``feed_transfer_seconds_total``     fenced wall time spent in transfers
+``readahead_depth``                 shard read-ahead depth currently allowed
 ==================================  =======================================
 
 The ``data.device_link`` chaos site injects a per-transfer delay inside the
@@ -351,6 +352,132 @@ class FeedAutotuner:
         self._fence(placed)
         self.note_transfer(nbytes, self._clock() - t0)
         return AutotunedWindow(placed, len(window))
+
+
+#: default upper bound for the stall-steered shard read-ahead depth
+#: (``ImagePipeline(readahead="auto")``): deep enough to hide a slow remote
+#: store behind decode, small enough that chunk queues stay bounded
+DEFAULT_MAX_READAHEAD = 8
+
+
+class ReadaheadAutotuner:
+    """Self-sizing controller for the shard read-ahead depth.
+
+    The third member of the autotuner family: :class:`FeedAutotuner` sizes
+    the packed device window, :class:`~tensorflowonspark_tpu.data.decode_plane.DecodeAutotuner`
+    sizes the decode worker pool, and this one sizes how many shards the
+    reader executor streams ahead of the parse stage — the knob that
+    matters when the stall classification says **io_bound** (remote stores:
+    gcsfuse, NFS, object stores with high per-read latency).
+
+    Decision rule per interval of ``check_every`` seconds, from the deltas
+    of the producer/consumer stall counters (the same accounting
+    ``bench.classify_stalls`` reads):
+
+    * consumer starved for more than ``starve_ratio`` of the interval AND
+      shard IO dominated the parse stage (``read_delta >= parse_delta`` —
+      the interval was io_bound, not decode_bound) → **deepen read-ahead
+      one shard immediately**. Starvation whose cause is decode is left to
+      the decode autotuner; deepening read-ahead cannot fix it.
+    * consumer essentially never starved (wait share below ``idle_ratio``)
+      → **shallow by one after ``down_patience`` consecutive idle
+      intervals** (hysteresis), releasing reader threads and chunk-queue
+      memory the pipeline demonstrably does not need.
+
+    Bounds ``[min_depth, max_depth]``. Counter reads and the clock are
+    injectable so the decision core is a pure function in tests, exactly
+    like the decode autotuner. Publishes the chosen depth on the
+    ``readahead_depth`` gauge.
+    """
+
+    def __init__(
+        self,
+        min_depth=1,
+        max_depth=DEFAULT_MAX_READAHEAD,
+        starve_ratio=0.05,
+        idle_ratio=0.01,
+        down_patience=2,
+        check_every=2.0,
+        clock=None,
+        read_counters=None,
+    ):
+        self.min_depth = max(1, int(min_depth))
+        self.max_depth = int(max_depth)
+        if self.max_depth < self.min_depth:
+            raise ValueError("max_depth must be >= min_depth")
+        self.starve_ratio = float(starve_ratio)
+        self.idle_ratio = float(idle_ratio)
+        self.down_patience = max(1, int(down_patience))
+        self.check_every = float(check_every)
+        self._clock = clock or time.monotonic
+        self._read = read_counters or self._read_obs
+        self._last_t = None
+        self._last = None
+        self._down_streak = 0
+        self._depth_g = obs.gauge(
+            "readahead_depth", help="shard read-ahead depth currently allowed"
+        )
+
+    @staticmethod
+    def _read_obs():
+        counters = obs.snapshot()["counters"]
+
+        def _c(counter_name):
+            return counters.get(counter_name, {}).get("value", 0.0)
+
+        return (
+            _c("data_producer_read_seconds_total"),
+            _c("data_producer_parse_seconds_total"),
+            _c("data_consumer_wait_seconds_total"),
+        )
+
+    def publish(self, depth):
+        """Publish ``depth`` on the ``readahead_depth`` gauge (the loader
+        calls this once at startup so the gauge exists before the first
+        interval elapses)."""
+        self._depth_g.set(int(depth))
+
+    def decide(self, depth, read_delta, parse_delta, wait_delta, elapsed):
+        """Pure decision: the read-ahead depth argued for by one interval's
+        counter deltas (no clock, no obs — the unit-testable core)."""
+        if elapsed <= 0:
+            return depth
+        wait_share = wait_delta / elapsed
+        if wait_share > self.starve_ratio and read_delta >= parse_delta:
+            self._down_streak = 0
+            return min(self.max_depth, depth + 1)
+        if wait_share < self.idle_ratio and depth > self.min_depth:
+            self._down_streak += 1
+            if self._down_streak >= self.down_patience:
+                self._down_streak = 0
+                return depth - 1
+            return depth
+        self._down_streak = 0
+        return depth
+
+    def tick(self, depth):
+        """Clocked wrapper for :meth:`decide`: reads the counters at most
+        every ``check_every`` seconds; returns the new target depth, or
+        None when the interval has not elapsed yet."""
+        now = self._clock()
+        if self._last_t is None:
+            self._last_t, self._last = now, self._read()
+            return None
+        elapsed = now - self._last_t
+        if elapsed < self.check_every:
+            return None
+        read, parse, wait = self._read()
+        target = self.decide(
+            depth,
+            read - self._last[0],
+            parse - self._last[1],
+            wait - self._last[2],
+            elapsed,
+        )
+        self._last_t, self._last = now, (read, parse, wait)
+        if target != depth:
+            self._depth_g.set(int(target))
+        return target
 
 
 def batch_nbytes(batch):

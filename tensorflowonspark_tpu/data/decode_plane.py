@@ -57,6 +57,7 @@ metric                              meaning
 ``decode_worker_restarts_total``    workers respawned after dying mid-round
 ``decode_slab_bytes``               bytes resident in the slab pool
 ``decode_slab_wait_seconds_total``  producer waits on an empty slab free list
+``decode_native_total``             records decoded by the native JPEG path
 ==================================  =======================================
 
 The ``data.decode_kill`` chaos site SIGKILLs one worker mid-round
@@ -131,6 +132,7 @@ def _worker_main(conn, parse_fn):
     # the parent's SIGINT belongs to the training process; workers die by
     # pipe EOF (retire/teardown) or SIGKILL (crash/chaos) only
     signal.signal(signal.SIGINT, signal.SIG_IGN)
+    into = getattr(parse_fn, "into", None)
     slabs = {}  # name -> SlabSegment kept attached across rounds
     while True:
         try:
@@ -143,10 +145,16 @@ def _worker_main(conn, parse_fn):
             slab = slabs.get(slab_name)
             if slab is None:
                 slab = slabs[slab_name] = SlabSegment.attach(slab_name)
-            img, lbl = parse_fn(rec)
             view = slab.ndarray((batch_size,) + tuple(shape), dtype)
-            view[slot] = img  # raises on shape/dtype mismatch vs slot 0
-            ack = (seq, slot, True, int(lbl))
+            if into is not None:
+                # native fast path: decode straight into the slab slot (no
+                # PIL, no intermediate copy); falls back to PIL internally
+                lbl, native = into(rec, view[slot])
+            else:
+                img, lbl = parse_fn(rec)
+                view[slot] = img  # raises on shape/dtype mismatch vs slot 0
+                native = False
+            ack = (seq, slot, True, (int(lbl), bool(native)))
         except Exception as e:
             ack = (seq, slot, False, "{}: {}".format(type(e).__name__, e))
         try:
@@ -212,6 +220,10 @@ class DecodePlane:
         self._slab_wait_c = obs.counter(
             "decode_slab_wait_seconds_total",
             help="seconds the producer waited on an empty slab free list",
+        )
+        self._native_c = obs.counter(
+            "decode_native_total",
+            help="records decoded by the native JPEG path (no PIL)",
         )
         for _ in range(int(workers)):
             self._spawn()
@@ -358,7 +370,9 @@ class DecodePlane:
                 pending.discard(slot)
                 owner.pop(slot, None)
                 if ok:
-                    labels[slot] = payload
+                    labels[slot] = payload[0]
+                    if payload[1]:
+                        self._native_c.inc()
                 else:
                     failures.append((slot, DecodeWorkerError(payload)))
 

@@ -135,6 +135,60 @@ def make_parse_fn(is_training, image_size=IMAGE_SIZE, label_offset=0, seed=0, ra
             image = preprocess_eval(image_bytes, image_size, raw_uint8=raw_uint8)
         return image, label
 
+    def into(record, out):
+        """record bytes → pixels written directly into ``out`` (a uint8
+        ``(image_size, image_size, 3)`` view of a shared-memory slab slot).
+
+        The native fast path: one C call decodes the JPEG and lands the
+        Pillow-exact crop/resize/flip in ``out`` — no PIL, no intermediate
+        copy. The augmentation rng is keyed and *drawn* in exactly
+        :func:`preprocess_train`'s order (crop-box draws, then the flip
+        draw), so native and PIL modes produce byte-identical streams.
+        Returns ``(label, used_native)``; any native failure — library
+        absent, unsupported coding, corrupt stream — falls back to the full
+        PIL parse, so a record is charged against ``max_bad_records``
+        exactly when PIL itself cannot decode it.
+        """
+        from tensorflowonspark_tpu import native_io
+
+        feats = tfrecord.decode_example(record)
+        image_bytes = feats["image/encoded"][1][0]
+        label = int(feats["image/class/label"][1][0]) + label_offset
+        if native_io.jpg_available():
+            try:
+                width, height = native_io.jpg_info(image_bytes)
+                if is_training:
+                    rng = np.random.default_rng((seed << 32) ^ zlib.crc32(record))
+                    x, y, w, h = _random_crop_box(width, height, rng)
+                    flip = rng.random() < 0.5
+                    native_io.jpg_decode_window(
+                        image_bytes, out, (x, y, x + w, y + h),
+                        (image_size, image_size), flip=flip)
+                else:
+                    scale = RESIZE_MIN / min(width, height)
+                    nw, nh = int(round(width * scale)), int(round(height * scale))
+                    ox, oy = (nw - image_size) // 2, (nh - image_size) // 2
+                    if ox < 0 or oy < 0:
+                        raise native_io.JpegError("image smaller than crop")
+                    native_io.jpg_decode_window(
+                        image_bytes, out, (0, 0, width, height), (nw, nh),
+                        window_origin=(ox, oy))
+                return label, True
+            except (native_io.JpegError, RuntimeError):
+                pass  # PIL below is both oracle and fallback
+        image, label = parse(record)
+        out[...] = image
+        return label, False
+
+    if raw_uint8:
+        # the native into-slab path produces uint8 pixels only; float32
+        # parses (mean-subtracted) keep the plain PIL closure
+        parse.into = into
+    #: decode-parameter fingerprint: keys the cross-epoch decoded-slab cache
+    #: (same bytes + same key ⇒ same pixels, in every decode mode)
+    parse.cache_key = "imagenet:v1:{}:{}:{}:{}:{}".format(
+        "train" if is_training else "eval", image_size, label_offset, seed,
+        int(bool(raw_uint8)))
     return parse
 
 

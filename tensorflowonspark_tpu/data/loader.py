@@ -41,11 +41,12 @@ import os
 import queue
 import threading
 import time
+import zlib
 
 import numpy as np
 
 from tensorflowonspark_tpu import chaos, obs, resilience
-from tensorflowonspark_tpu.data import decode_plane
+from tensorflowonspark_tpu.data import autotune, decode_plane, slab_cache
 
 logger = logging.getLogger(__name__)
 
@@ -207,7 +208,11 @@ class ImagePipeline:
 
     - ``readahead`` — how many shards the reader executor fetches ahead of
       the parse stage (default env ``TOS_DATA_READAHEAD`` or 2; 0 reads
-      shards inline, no IO/parse overlap).
+      shards inline, no IO/parse overlap). ``"auto"`` lets a
+      :class:`~tensorflowonspark_tpu.data.autotune.ReadaheadAutotuner`
+      steer the depth at runtime from the stall counters: deepen while the
+      interval is io_bound and the consumer starves, shallow when the
+      pipeline is comfortably ahead (published as ``readahead_depth``).
     - ``chunk_records`` — records per streamed chunk (default env
       ``TOS_DATA_CHUNK_RECORDS`` or 1024; 0 bulk-loads whole shards).
     - ``shuffle_buffer`` — bounded streaming shuffle window (the
@@ -235,6 +240,14 @@ class ImagePipeline:
       ``multiprocessing.shared_memory`` — otherwise the thread pool is used
       with a warning. The delivered batch stream is byte-identical across
       thread and process modes.
+    - ``slab_cache_dir`` — root for the cross-epoch decoded-slab cache
+      (default env ``TOS_SLAB_CACHE_DIR``; unset = off). Decoded rows are
+      persisted keyed by record crc32 under the ``parse_fn.cache_key``
+      decode-parameter fingerprint, so epoch ≥ 2 — and an elastic relaunch
+      over the same shards — fills slots from a memory map instead of
+      decoding (see :mod:`~tensorflowonspark_tpu.data.slab_cache`). Only
+      active when the ``parse_fn`` exposes ``cache_key``; the stream stays
+      byte-identical with the cache on, off, cold or warm.
 
     ``max_bad_records`` is the poisoned-input budget: records whose
     ``parse_fn`` raises are skipped (counted in
@@ -265,6 +278,7 @@ class ImagePipeline:
         cache=None,
         recycle_buffers=False,
         decode_workers=None,
+        slab_cache_dir=None,
     ):
         if not files:
             raise ValueError("no input files")
@@ -285,8 +299,16 @@ class ImagePipeline:
         self.drop_remainder = drop_remainder
         self.max_bad_records = int(max_bad_records)
         if readahead is None:
-            readahead = int(os.environ.get("TOS_DATA_READAHEAD", "2"))
-        self.readahead = max(0, int(readahead))
+            readahead = os.environ.get("TOS_DATA_READAHEAD", "2")
+        self.readahead_auto = str(readahead).strip().lower() == "auto"
+        if self.readahead_auto:
+            # stall-steered: the reader pool is sized to the ceiling; the
+            # live depth starts shallow and the ReadaheadAutotuner moves it
+            self.readahead = autotune.DEFAULT_MAX_READAHEAD
+            self._ra_depth = [min(2, self.readahead)]
+        else:
+            self.readahead = max(0, int(readahead))
+            self._ra_depth = [self.readahead]
         if chunk_records is None:
             chunk_records = int(os.environ.get("TOS_DATA_CHUNK_RECORDS", "1024"))
         self.chunk_records = max(0, int(chunk_records))
@@ -298,6 +320,7 @@ class ImagePipeline:
         self.cache = cache
         self.recycle_buffers = bool(recycle_buffers)
         self.decode_workers = decode_workers
+        self.slab_cache_dir = slab_cache.resolve_dir(slab_cache_dir)
         # raw cache: path -> [record bytes], marked complete only after a
         # full clean read; decoded cache: (path, record index) -> _Decoded
         self._raw_cache = {}
@@ -373,6 +396,13 @@ class ImagePipeline:
         terminated by ``_SHARD_END`` or the exception that broke the read."""
         try:
             for chunk in self._shard_chunks_sync(path, read_c):
+                if chaos.active:
+                    # a remote store gone slow: per-chunk latency inside the
+                    # reader task, charged to read time so the stall
+                    # classifier (and the readahead autotuner) sees io_bound
+                    t0 = time.monotonic()
+                    if chaos.delay("data.readahead_stall"):
+                        read_c.inc(time.monotonic() - t0)
                 if not _stop_put(q, chunk, stop, abort):
                     return
             _stop_put(q, _SHARD_END, stop, abort)
@@ -391,7 +421,9 @@ class ImagePipeline:
         ahead = [0]
 
         def _top_up():
-            while ahead[0] < len(order) and len(inflight) < self.readahead:
+            # the live depth (not self.readahead): with readahead="auto"
+            # the ReadaheadAutotuner moves it inside [1, self.readahead]
+            while ahead[0] < len(order) and len(inflight) < self._ra_depth[0]:
                 idx = ahead[0]
                 ahead[0] += 1
                 path = order[idx]
@@ -426,7 +458,7 @@ class ImagePipeline:
                 yield item
             fut.result()
 
-    def _record_stream(self, reader_pool, stop, abort, read_c):
+    def _record_stream(self, reader_pool, stop, abort, read_c, on_epoch_end=None):
         # two independent RNGs: shard order must not depend on how many
         # records the shuffle buffer drew, or determinism across
         # shuffle_buffer settings would silently couple to shard sizes
@@ -447,6 +479,10 @@ class ImagePipeline:
                 records = _shuffle_stream(records, shuffle_rng, self.shuffle_buffer)
             for rec in records:
                 yield rec
+            if on_epoch_end is not None:
+                # epoch boundary (shuffle buffer drained): the slab-cache
+                # commit hook runs here, in the producer thread
+                on_epoch_end()
             epoch += 1
 
     # -- stage 3: zero-copy batch assembly --------------------------------------
@@ -497,6 +533,10 @@ class ImagePipeline:
             help="seconds the consumer waited on an empty prefetch queue "
             "(starvation: the input pipeline is the bottleneck)",
         )
+        native_c = obs.counter(
+            "decode_native_total",
+            help="records decoded by the native JPEG path (no PIL)",
+        )
 
         # the decode plane forks its workers HERE, before any pipeline
         # thread exists (the reader/parse executors spawn lazily, on first
@@ -525,6 +565,19 @@ class ImagePipeline:
             if self.readahead > 0
             else None
         )
+        ra_tuner = None
+        if reader_pool is not None and self.readahead_auto:
+            ra_tuner = autotune.ReadaheadAutotuner(max_depth=self.readahead)
+            ra_tuner.publish(self._ra_depth[0])
+
+        # cross-epoch decoded-slab cache: constructed lazily once bootstrap
+        # fixes the batch geometry (cache_box[0] stays None when off)
+        cache_box = [None]
+        cache_key = getattr(self.parse_fn, "cache_key", None)
+        cache_root = self.slab_cache_dir if cache_key is not None else None
+        # the thread-mode native fast path (process mode binds it in the
+        # worker): only sound when the parse_fn advertises into-slab decode
+        into = getattr(self.parse_fn, "into", None)
 
         def _final_put(item):
             # never block forever on a departed consumer: its finally drains
@@ -595,9 +648,33 @@ class ImagePipeline:
                 except Exception as e:
                     return _ParseError(e)
 
+            def _rec_bytes(el):
+                """Raw record bytes of a stream element (None for a
+                decoded-cache hit — nothing left to key or decode)."""
+                if isinstance(el, _Decoded):
+                    return None
+                return el.rec if isinstance(el, _Keyed) else el
+
             def _parse_slot(el, slot):
                 """Pool worker: decode ``el`` straight into buffer slot
                 ``slot``. Distinct slots per worker — no write overlap."""
+                if into is not None and not isinstance(el, _Decoded):
+                    # native fast path: one C call lands decode+crop+resize+
+                    # flip in the slot; any failure inside into() already
+                    # fell back to PIL, so an exception here means the
+                    # record is genuinely undecodable (budget accounting
+                    # identical to the plain path)
+                    rec, key = (el.rec, el.key) if isinstance(el, _Keyed) else (el, None)
+                    try:
+                        lbl, used_native = into(rec, images[slot])
+                        labels[slot] = lbl
+                    except Exception as e:
+                        return (slot, _ParseError(e))
+                    if used_native:
+                        native_c.inc()
+                    if key is not None:
+                        self._decoded[key] = _Decoded(np.array(images[slot]), int(lbl))
+                    return None
                 p = _parse_el(el)
                 if not isinstance(p, _ParseError):
                     try:
@@ -647,6 +724,29 @@ class ImagePipeline:
                     _emit(images, labels)
                 _next_buffers()
 
+            def _slab_hit(el, slot):
+                """Serve ``el`` from the cross-epoch slab cache if it can:
+                the cached row is written into the slot parent-side (the
+                hit leases the slot without touching a worker or a pool
+                thread). Returns the record's crc (a miss, to be staged
+                after decode), True (served), or None (cache off /
+                already-decoded element)."""
+                cache = cache_box[0]
+                rec = _rec_bytes(el)
+                if cache is None or rec is None:
+                    return None
+                crc = zlib.crc32(rec)
+                hit = cache.lookup(crc)
+                if hit is None:
+                    return crc
+                images[slot] = hit[0]
+                labels[slot] = hit[1]
+                if isinstance(el, _Keyed):
+                    self._decoded[el.key] = _Decoded(
+                        np.array(images[slot]), int(labels[slot])
+                    )
+                return True
+
             def _plane_round(els, slots):
                 """Decode one round on the process plane: cache hits are
                 written inline (already-decoded pixels never cross a
@@ -656,6 +756,7 @@ class ImagePipeline:
                 results = []
                 tasks = []
                 keyed = {}
+                crcs = {}  # slot -> record crc for slab-cache misses
                 for el, slot in zip(els, slots):
                     if isinstance(el, _Decoded):
                         try:
@@ -664,6 +765,15 @@ class ImagePipeline:
                         except Exception as e:  # shape/dtype mismatch
                             results.append((slot, _ParseError(e)))
                         continue
+                    try:
+                        served = _slab_hit(el, slot)
+                    except Exception as e:  # cached-row geometry mismatch
+                        results.append((slot, _ParseError(e)))
+                        continue
+                    if served is True:
+                        continue
+                    if served is not None:
+                        crcs[slot] = served
                     rec, key = el, None
                     if isinstance(el, _Keyed):
                         rec, key = el.rec, el.key
@@ -685,7 +795,42 @@ class ImagePipeline:
                         self._decoded[key] = _Decoded(
                             np.array(images[slot]), int(labels[slot])
                         )
+                if cache_box[0] is not None:
+                    for slot, crc in crcs.items():
+                        if slot not in failed:
+                            cache_box[0].put(crc, images[slot], labels[slot])
                 plane.autotune_tick()
+                return results
+
+            def _thread_round(els, slots):
+                """Decode one round on the in-process pool: slab-cache hits
+                are written inline by the producer (the cache is
+                single-threaded by contract), misses fan out to the pool
+                and their freshly decoded rows are staged back."""
+                results = []
+                run_els = []
+                run_slots = []
+                crcs = {}
+                for el, slot in zip(els, slots):
+                    try:
+                        served = _slab_hit(el, slot)
+                    except Exception as e:  # cached-row geometry mismatch
+                        results.append((slot, _ParseError(e)))
+                        continue
+                    if served is True:
+                        continue
+                    if served is not None:
+                        crcs[slot] = served
+                    run_els.append(el)
+                    run_slots.append(slot)
+                results.extend(
+                    r for r in pool.map(_parse_slot, run_els, run_slots) if r is not None
+                )
+                if cache_box[0] is not None and crcs:
+                    failed = {slot for slot, _ in results}
+                    for slot, crc in crcs.items():
+                        if slot not in failed:
+                            cache_box[0].put(crc, images[slot], labels[slot])
                 return results
 
             def _round():
@@ -700,15 +845,17 @@ class ImagePipeline:
                 if plane is not None:
                     results = _plane_round(pending, slots)
                 else:
-                    results = list(pool.map(_parse_slot, pending, slots))
+                    results = _thread_round(pending, slots)
                 parse_c.inc(time.monotonic() - t0)
+                if ra_tuner is not None:
+                    target = ra_tuner.tick(self._ra_depth[0])
+                    if target is not None:
+                        self._ra_depth[0] = target
                 pending = []
                 holes = []
-                for r in results:
-                    if r is not None:
-                        slot, perr = r
-                        _absorb(perr.error)
-                        holes.append(slot)
+                for slot, perr in results:
+                    _absorb(perr.error)
+                    holes.append(slot)
                 free_slots = free_slots[len(slots):] + holes
                 if not free_slots:
                     _emit_full()
@@ -725,12 +872,33 @@ class ImagePipeline:
                 img = np.asarray(p[0])
                 img_meta["shape"] = img.shape
                 img_meta["dtype"] = np.float32 if img.dtype == np.float64 else img.dtype
+                if cache_root is not None:
+                    # geometry is now known: open (or create) the decoded-
+                    # slab cache scoped by the decode-parameter fingerprint
+                    try:
+                        cache_box[0] = slab_cache.SlabCache(
+                            cache_root, cache_key, img_meta["shape"], img_meta["dtype"]
+                        )
+                    except Exception as e:
+                        logger.warning("decoded-slab cache disabled: %s", e)
                 _next_buffers()
                 images[0] = img
                 labels[0] = p[1]
                 free_slots = free_slots[1:]
+                rec = _rec_bytes(el)
+                if cache_box[0] is not None and rec is not None:
+                    cache_box[0].put(zlib.crc32(rec), images[0], labels[0])
                 if not free_slots:
                     _emit_full()
+
+            def _epoch_end():
+                # flush the epoch's tail round so its rows make this commit
+                # (slot assignment is unchanged: the same records land in
+                # the same lowest free slots, just one round earlier), then
+                # seal the staged generation — epoch >= 2 reads it back
+                _round()
+                if cache_box[0] is not None:
+                    cache_box[0].commit()
 
             try:
                 # with a decode plane the parse happens out of process; the
@@ -741,7 +909,9 @@ class ImagePipeline:
                     else ThreadPoolExecutor(self.num_threads)
                 )
                 with pool_cm as pool:
-                    for rec in self._record_stream(reader_pool, stop, abort, read_c):
+                    for rec in self._record_stream(
+                        reader_pool, stop, abort, read_c, on_epoch_end=_epoch_end
+                    ):
                         if stop.is_set():
                             return
                         # poison is rolled here, in the producer thread, so
@@ -772,6 +942,10 @@ class ImagePipeline:
                 _final_put(e)
                 return
             finally:
+                if cache_box[0] is not None:
+                    # uncommitted staging is discarded (the commit contract:
+                    # a generation exists fully or not at all)
+                    cache_box[0].close()
                 _final_put(_END)
                 abort.set()
                 if reader_pool is not None:

@@ -1,0 +1,285 @@
+"""Cross-epoch decoded-slab cache: decode each record once per job, not once
+per epoch.
+
+Epoch 1 decodes every record (native or PIL) and the loader streams the
+pixels into shared-memory slab slots; this module persists those decoded
+rows so epoch >= 2 — and an elastic relaunch that re-reads the same shard
+set — fills slots straight from a page-cached memory map instead of running
+JPEG decode at all. A cache hit "leases a slot without touching a worker":
+the loader writes the cached row parent-side and the decode plane never
+sees the task.
+
+Keying: a cache directory is scoped by the *decode-parameter fingerprint*
+(``parse_fn.cache_key`` — train/eval, image size, augmentation seed, ...)
+and rows inside it are keyed by the record's crc32. Same bytes + same
+parameters ⇒ same pixels in every decode mode (the byte-identical stream
+contract pinned by tests/test_loader_pipeline.py), so a cached row is
+interchangeable with a fresh decode.
+
+Durability uses the checkpoint commit pattern
+(:mod:`tensorflowonspark_tpu.ckpt.manifest`): rows append to a staging
+directory (``tmp.gen-*``), and :meth:`SlabCache.commit` seals it — fsync
+the data file, write ``index.json``, write ``MANIFEST.json`` last, one
+atomic rename to ``gen-<n>``. A generation is *adopted* only after
+``manifest.verify`` passes on the published directory, so a torn commit
+(crash mid-publish, or the ``data.cache_tear`` chaos site) is rejected and
+its records simply decode again — the cache can serve stale-free or serve
+nothing, never serve garbage.
+
+Observability (rows in docs/architecture.md's Metrics inventory):
+
+==================================  =======================================
+metric                              meaning
+==================================  =======================================
+``decode_cache_hits_total``         slot fills served from the cache
+``decode_cache_rejects_total``      generations rejected by cheap-verify
+``decode_cache_bytes``              bytes resident in committed generations
+==================================  =======================================
+
+Single-threaded by design: only the loader's producer thread touches a
+``SlabCache`` (lookup/put/commit all happen on the slot-assignment path),
+mirroring how the decode plane's lease protocol is driven from one thread.
+"""
+
+import json
+import logging
+import os
+import shutil
+import uuid
+
+import numpy as np
+
+from tensorflowonspark_tpu import chaos, obs
+from tensorflowonspark_tpu.ckpt import manifest
+
+logger = logging.getLogger(__name__)
+
+#: env default for the loader's ``slab_cache_dir`` knob
+ENV_VAR = "TOS_SLAB_CACHE_DIR"
+
+_DATA_NAME = "data.bin"
+_INDEX_NAME = "index.json"
+
+
+def resolve_dir(slab_cache_dir):
+    """Normalize the loader knob: ``None`` reads :data:`ENV_VAR` (default
+    off), empty string means off. Returns a path or None."""
+    if slab_cache_dir is None:
+        slab_cache_dir = os.environ.get(ENV_VAR, "")
+    return slab_cache_dir or None
+
+
+def _fingerprint(cache_key):
+    """Filesystem-safe directory name for one decode-parameter set: a
+    readable prefix plus a crc to keep distinct keys from colliding after
+    sanitization."""
+    import zlib
+
+    safe = "".join(c if c.isalnum() or c in "._-" else "_" for c in str(cache_key))
+    return "{}-{:08x}".format(safe[:80], zlib.crc32(str(cache_key).encode()))
+
+
+class SlabCache:
+    """Persistent decoded-row store for one ``(decode params, geometry)``.
+
+    ``lookup(key)`` returns ``(pixels, label)`` from a committed generation
+    (zero-copy view of a memory map) or None; ``put(key, pixels, label)``
+    stages a freshly decoded row; ``commit()`` seals the staged rows into a
+    new generation (call at epoch boundaries). Rows staged but never
+    committed are discarded on :meth:`close` — exactly the checkpoint
+    staging-dir contract.
+    """
+
+    def __init__(self, root, cache_key, shape, dtype):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        if self.dtype.hasobject:
+            raise ValueError("slab cache rows must be a plain binary dtype")
+        self.dir = os.path.join(
+            os.path.abspath(os.path.expanduser(root)), _fingerprint(cache_key)
+        )
+        os.makedirs(self.dir, exist_ok=True)
+        self._row_bytes = int(np.prod(self.shape)) * self.dtype.itemsize
+        self._maps = []  # committed: (memmap, {key: (row, label)})
+        self._index = {}  # key -> (map idx, row) merged across generations
+        self._staging = None  # (dir, open data file, {key: (row, label)})
+        self._hits_c = obs.counter(
+            "decode_cache_hits_total", help="slot fills served from the decoded-slab cache"
+        )
+        self._rejects_c = obs.counter(
+            "decode_cache_rejects_total",
+            help="decoded-slab cache generations rejected by cheap-verify",
+        )
+        self._bytes_g = obs.gauge(
+            "decode_cache_bytes", help="bytes resident in committed decoded-slab generations"
+        )
+        self._load_generations()
+
+    # -- read side --------------------------------------------------------------
+
+    def _load_generations(self):
+        for name in sorted(os.listdir(self.dir)):
+            gen = os.path.join(self.dir, name)
+            if not name.startswith("gen-") or not os.path.isdir(gen):
+                continue
+            ok, reason = manifest.verify(gen)
+            if not ok or manifest.read_manifest(gen) is None:
+                logger.warning("slab cache: rejecting %s (%s)", gen, reason if not ok else "no manifest")
+                self._rejects_c.inc()
+                shutil.rmtree(gen, ignore_errors=True)
+                continue
+            try:
+                with open(os.path.join(gen, _INDEX_NAME)) as f:
+                    meta = json.load(f)
+                if tuple(meta["shape"]) != self.shape or meta["dtype"] != self.dtype.str:
+                    logger.warning("slab cache: %s has geometry %s/%s, want %s/%s; skipping",
+                                   gen, meta.get("shape"), meta.get("dtype"),
+                                   list(self.shape), self.dtype.str)
+                    continue
+                rows = len(meta["keys"])
+                mm = np.memmap(os.path.join(gen, _DATA_NAME), mode="r",
+                               dtype=self.dtype, shape=(rows,) + self.shape)
+            except (OSError, ValueError, KeyError) as e:
+                logger.warning("slab cache: rejecting %s (%s)", gen, e)
+                self._rejects_c.inc()
+                shutil.rmtree(gen, ignore_errors=True)
+                continue
+            idx = len(self._maps)
+            table = {}
+            for row, (key, label) in enumerate(zip(meta["keys"], meta["labels"])):
+                table[int(key)] = (row, int(label))
+                self._index[int(key)] = (idx, row)
+            self._maps.append((mm, table))
+        self._bytes_g.set(float(sum(mm.nbytes for mm, _ in self._maps)))
+        if self._index:
+            logger.info("slab cache: %d row(s) across %d generation(s) at %s",
+                        len(self._index), len(self._maps), self.dir)
+
+    def _next_gen_dir(self):
+        """First unused ``gen-<n>`` name. Collisions with a concurrent
+        publisher surface as an OSError from :func:`os.rename` (rename onto
+        an existing non-empty dir fails), which commit() treats as a reject
+        — never as silent corruption."""
+        taken = set()
+        for name in os.listdir(self.dir):
+            if name.startswith("gen-"):
+                try:
+                    taken.add(int(name[4:]))
+                except ValueError:
+                    pass
+        n = 0
+        while n in taken:
+            n += 1
+        return os.path.join(self.dir, "gen-{:06d}".format(n))
+
+    def lookup(self, key):
+        """``(pixels, label)`` for a record crc, or None. The pixels are a
+        read-only view of the generation's memory map — copy-on-assign into
+        the slab slot is the single copy on the hit path."""
+        loc = self._index.get(int(key))
+        if loc is None:
+            return None
+        mm, table = self._maps[loc[0]]
+        row, label = table[int(key)]
+        self._hits_c.inc()
+        return mm[row], label
+
+    def __len__(self):
+        return len(self._index)
+
+    # -- write side -------------------------------------------------------------
+
+    def put(self, key, pixels, label):
+        """Stage one decoded row (no-op when the key is already cached or
+        already staged). ``pixels`` must match the cache geometry."""
+        key = int(key)
+        if key in self._index:
+            return
+        if self._staging is None:
+            stage = os.path.join(self.dir, "tmp.gen-{}".format(uuid.uuid4().hex[:8]))
+            os.makedirs(stage)
+            self._staging = (stage, open(os.path.join(stage, _DATA_NAME), "wb"), {})
+        stage, data_f, staged = self._staging
+        if key in staged:
+            return
+        arr = np.ascontiguousarray(pixels, dtype=self.dtype)
+        if arr.shape != self.shape:
+            raise ValueError("row shape {} != cache geometry {}".format(arr.shape, self.shape))
+        data_f.write(arr.tobytes())
+        staged[key] = (len(staged), int(label))
+
+    def commit(self):
+        """Seal the staged rows into a committed generation: fsync data,
+        ``index.json``, ``MANIFEST.json`` last, atomic rename, then adopt
+        the generation only after cheap-verify passes on the published
+        directory (a torn publish is rejected and deleted — its records
+        decode again). Returns the number of rows committed, 0 when nothing
+        was staged."""
+        if self._staging is None:
+            return 0
+        stage, data_f, staged = self._staging
+        self._staging = None
+        if not staged:
+            data_f.close()
+            shutil.rmtree(stage, ignore_errors=True)
+            return 0
+        data_f.flush()
+        os.fsync(data_f.fileno())
+        data_f.close()
+        keys = sorted(staged, key=lambda k: staged[k][0])
+        meta = {
+            "shape": list(self.shape),
+            "dtype": self.dtype.str,
+            "keys": keys,
+            "labels": [staged[k][1] for k in keys],
+        }
+        with open(os.path.join(stage, _INDEX_NAME), "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        manifest.write_manifest(stage)
+        if chaos.active and chaos.fire("data.cache_tear"):
+            # publish a *torn* manifest: the commit marker exists but lies,
+            # exactly what a crash between manifest write and fsync leaves
+            mpath = os.path.join(stage, manifest.MANIFEST_NAME)
+            with open(mpath, "r+") as f:
+                f.truncate(os.path.getsize(mpath) // 2)
+        final = self._next_gen_dir()
+        try:
+            os.rename(stage, final)
+        except OSError as e:
+            logger.warning("slab cache: publish rename failed (%s); dropping", e)
+            self._rejects_c.inc()
+            shutil.rmtree(stage, ignore_errors=True)
+            return 0
+        ok, reason = manifest.verify(final)
+        if not ok:
+            logger.warning("slab cache: published generation failed verify (%s); dropping", reason)
+            self._rejects_c.inc()
+            shutil.rmtree(final, ignore_errors=True)
+            return 0
+        rows = len(keys)
+        mm = np.memmap(os.path.join(final, _DATA_NAME), mode="r",
+                       dtype=self.dtype, shape=(rows,) + self.shape)
+        idx = len(self._maps)
+        table = {}
+        for row, key in enumerate(keys):
+            table[key] = (row, staged[key][1])
+            self._index[key] = (idx, row)
+        self._maps.append((mm, table))
+        self._bytes_g.set(float(sum(m.nbytes for m, _ in self._maps)))
+        logger.info("slab cache: committed %d row(s) (%d total) at %s", rows, len(self._index), self.dir)
+        return rows
+
+    def close(self):
+        """Release memory maps and discard any uncommitted staging dir."""
+        if self._staging is not None:
+            stage, data_f, _staged = self._staging
+            self._staging = None
+            try:
+                data_f.close()
+            except OSError:
+                pass
+            shutil.rmtree(stage, ignore_errors=True)
+        self._maps = []
+        self._index = {}

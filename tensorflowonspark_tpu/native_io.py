@@ -89,6 +89,45 @@ def _bind(lib):
             "`make -B` in native/)"
         )
         lib.tfr_has_stream = False
+    # JPEG decode entry points (decode straight into a slab slot); a stale
+    # prebuilt library without them still serves the record APIs — callers
+    # check jpg_available() and fall back to PIL
+    try:
+        lib.tfr_build_info.restype = ctypes.c_char_p
+        lib.tfr_build_info.argtypes = []
+        lib.jpg_info.restype = ctypes.c_int32
+        lib.jpg_info.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.jpg_decode_window.restype = ctypes.c_int32
+        lib.jpg_decode_window.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+        ]
+        lib.tfr_has_jpeg = True
+    except AttributeError:
+        logger.warning(
+            "native tfrecord_io library predates the JPEG decode API; "
+            "image decode falls back to PIL (rebuild with `make -B` in "
+            "native/)"
+        )
+        lib.tfr_has_jpeg = False
     return lib
 
 
@@ -246,3 +285,80 @@ def masked_crc32c(data):
         raise RuntimeError("native tfrecord_io not available")
     buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data else (ctypes.c_uint8 * 1)()
     return lib.tfr_masked_crc32c(buf, len(data))
+
+
+#: env kill-switch: TOS_NATIVE_DECODE=0 forces the PIL decode path even when
+#: the library carries the jpg_* entry points (bit-exactness A/B runs, and an
+#: escape hatch if a platform's decode ever diverges)
+DECODE_ENV_VAR = "TOS_NATIVE_DECODE"
+
+
+def jpg_available():
+    """True when native JPEG decode can be used: the loaded library carries
+    the ``jpg_*`` entry points and :data:`DECODE_ENV_VAR` doesn't veto it."""
+    if os.environ.get(DECODE_ENV_VAR, "1") == "0":
+        return False
+    lib = load_library()
+    return lib is not None and lib.tfr_has_jpeg
+
+
+def build_info():
+    """The native build fingerprint string (``tfr_build_info()``), e.g.
+    ``"tfrecord_io jpeg=libjpeg-turbo api=62"``, or None when the loaded
+    library predates the JPEG API (or no library loaded at all)."""
+    lib = load_library()
+    if lib is None or not lib.tfr_has_jpeg:
+        return None
+    return lib.tfr_build_info().decode()
+
+
+class JpegError(ValueError):
+    """Native JPEG decode failed: corrupt/truncated stream or a coding the
+    backend doesn't support. A ``ValueError`` so the loader's bad-record
+    accounting treats it exactly like a PIL decode failure."""
+
+
+def jpg_info(data):
+    """``(width, height)`` from the JPEG header, without a full decode."""
+    lib = load_library()
+    if lib is None or not lib.tfr_has_jpeg:
+        raise RuntimeError("native JPEG decode not available")
+    w = ctypes.c_int32()
+    h = ctypes.c_int32()
+    if lib.jpg_info(data, len(data), ctypes.byref(w), ctypes.byref(h)) != 0:
+        raise JpegError(lib.tfr_last_error().decode() or "jpg_info failed")
+    return w.value, h.value
+
+
+def jpg_decode_window(data, out, box, resize, window_origin=(0, 0), flip=False):
+    """Decode ``data`` and write a resized window straight into ``out``.
+
+    The single-call native hot path: decode, Pillow-exact bilinear resize of
+    the source rect ``box`` (``(x0, y0, x1, y1)`` floats, PIL ``box=``
+    semantics) to ``resize`` (``(width, height)``), then the window of that
+    resize starting at ``window_origin`` with ``out``'s shape — horizontally
+    mirrored when ``flip`` — lands in ``out``: a C-contiguous-rows uint8
+    ``(H, W, 3)`` numpy view, typically a shared-memory slab slot. No PIL,
+    no intermediate copy. Raises :class:`JpegError` on corrupt input or an
+    unsupported coding (caller falls back to PIL).
+    """
+    lib = load_library()
+    if lib is None or not lib.tfr_has_jpeg:
+        raise RuntimeError("native JPEG decode not available")
+    if out.dtype.str != "|u1" or out.ndim != 3 or out.shape[2] != 3:
+        raise ValueError("out must be a uint8 (H, W, 3) array, got {} {}".format(
+            out.dtype, out.shape))
+    if out.strides[1] != 3 or out.strides[2] != 1:
+        raise ValueError("out rows must be C-contiguous")
+    oh, ow = out.shape[0], out.shape[1]
+    ox, oy = window_origin
+    rc = lib.jpg_decode_window(
+        data, len(data),
+        float(box[0]), float(box[1]), float(box[2]), float(box[3]),
+        int(resize[0]), int(resize[1]),
+        int(ox), int(oy), int(ow), int(oh),
+        1 if flip else 0,
+        out.ctypes.data_as(ctypes.c_void_p), out.strides[0],
+    )
+    if rc != 0:
+        raise JpegError(lib.tfr_last_error().decode() or "jpg_decode_window failed")

@@ -14,13 +14,32 @@ _spec.loader.exec_module(bench)
 
 def test_confidence_fields_full_budget():
     # all requested pairs recorded and valid: no low-confidence flag
-    assert bench.confidence_fields(6, 6) == {"pairs": 6, "pairs_requested": 6}
-    assert bench.confidence_fields(7, 6) == {"pairs": 7, "pairs_requested": 6}
+    assert bench.confidence_fields(6, 6) == {
+        "pairs": 6, "pairs_requested": 6, "pairs_completed": 6,
+    }
+    assert bench.confidence_fields(7, 6) == {
+        "pairs": 7, "pairs_requested": 6, "pairs_completed": 7,
+    }
 
 
-def test_confidence_fields_budget_exhausted():
+def test_confidence_fields_short_run_flags_low_confidence():
     out = bench.confidence_fields(3, 6)
-    assert out == {"pairs": 3, "pairs_requested": 6, "low_confidence": True}
+    assert out == {
+        "pairs": 3, "pairs_requested": 6, "pairs_completed": 3,
+        "low_confidence": True,
+    }
+
+
+def test_confidence_fields_budget_exhausted_is_reported():
+    # the budget (not the rep count) ended the run: say so explicitly, on
+    # top of the sample-count accounting
+    out = bench.confidence_fields(3, 6, budget_exhausted=True)
+    assert out == {
+        "pairs": 3, "pairs_requested": 6, "pairs_completed": 3,
+        "budget_exhausted": True, "low_confidence": True,
+    }
+    # a full run never carries the flag
+    assert "budget_exhausted" not in bench.confidence_fields(6, 6)
 
 
 def test_confidence_fields_zero_pairs():
@@ -33,6 +52,7 @@ def test_confidence_fields_invalid_pairs_lower_confidence():
     out = bench.confidence_fields(6, 6, invalid_pairs=1)
     assert out["pairs"] == 6
     assert out["invalid_pairs"] == 1
+    assert out["pairs_completed"] == 5
     assert out["low_confidence"] is True
 
 

@@ -415,3 +415,213 @@ class TestOverlapSmoke:
         assert parse_s > 0.2, parse_s
         # the pipelining claim itself: wall beats the serial sum
         assert wall < 0.9 * (read_s + parse_s), (wall, read_s, parse_s)
+
+
+def _make_jpeg_parse():
+    from tensorflowonspark_tpu.data import imagenet
+
+    return imagenet.make_parse_fn(True, image_size=16, seed=5, raw_uint8=True)
+
+
+@pytest.fixture
+def jpeg_shards(tmp_path):
+    """Two shards of real JPEG Examples (labels = global index 0..59), the
+    decode-mode matrix's substrate: every decode path must produce the same
+    pixels from these bytes."""
+    from tensorflowonspark_tpu.data import imagenet
+
+    rng = np.random.default_rng(0)
+    paths, n = [], 0
+    for s in range(2):
+        p = str(tmp_path / "img-{:05d}".format(s))
+        with tfrecord.TFRecordWriter(p) as w:
+            for _ in range(30):
+                img = rng.integers(
+                    0, 256, (24 + n % 5, 24 + n % 3, 3), dtype=np.uint8
+                )
+                w.write(imagenet.encode_example(img, n))
+                n += 1
+        paths.append(p)
+    return paths
+
+
+def _jstream(paths, slab_cache_dir=None, **kw):
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("seed", 3)
+    kw.setdefault("epochs", 1)
+    kw.setdefault("readahead", 2)
+    kw.setdefault("chunk_records", 16)
+    pipe = ImagePipeline(
+        paths, _make_jpeg_parse(), slab_cache_dir=slab_cache_dir, **kw
+    )
+    return [(b["image"].tobytes(), b["label"].tobytes()) for b in pipe]
+
+
+class TestNativeDecodeAndSlabCache:
+    """The byte-identical-stream contract across decode implementations:
+    PIL threads, native threads, native worker processes, and the
+    cross-epoch decoded-slab cache must all deliver the same batches — and
+    charge a corrupt JPEG against ``max_bad_records`` identically."""
+
+    def test_stream_invariant_across_decode_modes(self, jpeg_shards, tmp_path, monkeypatch):
+        from tensorflowonspark_tpu.data import decode_plane
+
+        base = _jstream(jpeg_shards)  # thread pool, native when available
+        if native_io.jpg_available():
+            native = _counter("decode_native_total")
+            assert _jstream(jpeg_shards) == base
+            assert _counter("decode_native_total") > native
+        # PIL-forced threads
+        monkeypatch.setenv(native_io.DECODE_ENV_VAR, "0")
+        assert _jstream(jpeg_shards) == base
+        monkeypatch.delenv(native_io.DECODE_ENV_VAR)
+        # worker processes (native inside the workers)
+        if decode_plane.available():
+            assert _jstream(jpeg_shards, decode_workers=2) == base
+        # cold cache, then a warm run served from committed generations
+        cache = str(tmp_path / "slab-cache")
+        assert _jstream(jpeg_shards, slab_cache_dir=cache) == base
+        hits = _counter("decode_cache_hits_total")
+        assert _jstream(jpeg_shards, slab_cache_dir=cache) == base
+        # 59 of 60: the bootstrap record is decoded parent-side to learn
+        # the slab geometry BEFORE the cache can open (it needs the shape)
+        assert _counter("decode_cache_hits_total") - hits == 59
+        # and a warm PROCESS run: hits lease slots without touching a worker
+        if decode_plane.available():
+            assert _jstream(jpeg_shards, slab_cache_dir=cache, decode_workers=2) == base
+
+    def test_epoch_two_is_served_from_the_cache(self, jpeg_shards, tmp_path):
+        cache = str(tmp_path / "slab-cache")
+        base = _jstream(jpeg_shards, epochs=2)
+        hits = _counter("decode_cache_hits_total")
+        assert _jstream(jpeg_shards, epochs=2, slab_cache_dir=cache) == base
+        # epoch 1 decoded and committed; epoch 2 hit for every record
+        assert _counter("decode_cache_hits_total") - hits == 60
+        assert obs.snapshot()["gauges"]["decode_cache_bytes"]["value"] > 0
+
+    def test_cache_survives_pipeline_objects(self, jpeg_shards, tmp_path):
+        # the elastic-relaunch shape: a NEW pipeline (fresh process in real
+        # life) over the same shards + params adopts the committed
+        # generations and skips decode entirely
+        cache = str(tmp_path / "slab-cache")
+        base = _jstream(jpeg_shards, slab_cache_dir=cache)
+        hits = _counter("decode_cache_hits_total")
+        native = _counter("decode_native_total")
+        assert _jstream(jpeg_shards, slab_cache_dir=cache) == base
+        assert _counter("decode_cache_hits_total") - hits == 59  # 60 - bootstrap
+        assert _counter("decode_native_total") == native  # no native decode at all
+
+    def test_cache_is_scoped_by_decode_params(self, jpeg_shards, tmp_path):
+        from tensorflowonspark_tpu.data import imagenet
+
+        cache = str(tmp_path / "slab-cache")
+        _jstream(jpeg_shards, slab_cache_dir=cache)
+        hits = _counter("decode_cache_hits_total")
+        # a different augmentation seed is a different cache_key: the
+        # committed generation must NOT serve it
+        parse = imagenet.make_parse_fn(True, image_size=16, seed=6, raw_uint8=True)
+        pipe = ImagePipeline(
+            jpeg_shards, parse, batch_size=4, seed=3, epochs=1,
+            slab_cache_dir=cache,
+        )
+        for _ in pipe:
+            pass
+        assert _counter("decode_cache_hits_total") == hits
+
+    def test_env_knob_engages_the_cache(self, jpeg_shards, tmp_path, monkeypatch):
+        base = _jstream(jpeg_shards)
+        monkeypatch.setenv("TOS_SLAB_CACHE_DIR", str(tmp_path / "env-cache"))
+        assert _jstream(jpeg_shards) == base
+        hits = _counter("decode_cache_hits_total")
+        assert _jstream(jpeg_shards) == base
+        assert _counter("decode_cache_hits_total") - hits == 59  # 60 - bootstrap
+
+    def test_corrupt_jpeg_charged_identically_in_all_modes(self, tmp_path, monkeypatch):
+        from tensorflowonspark_tpu import tfrecord as tfr
+        from tensorflowonspark_tpu.data import decode_plane, imagenet
+
+        rng = np.random.default_rng(1)
+        p = str(tmp_path / "poisoned-00000")
+        with tfrecord.TFRecordWriter(p) as w:
+            for i in range(12):
+                if i == 7:  # valid Example, garbage JPEG bytes (last
+                    # slot of round 2, so the backfill keeps label order)
+                    w.write(tfr.encode_example({
+                        "image/encoded": [b"\xff\xd8 not a jpeg"],
+                        "image/class/label": [7],
+                    }))
+                else:
+                    img = rng.integers(0, 256, (24, 24, 3), dtype=np.uint8)
+                    w.write(imagenet.encode_example(img, i))
+
+        def labels(max_bad, **kw):
+            pipe = ImagePipeline(
+                [p], _make_jpeg_parse(), batch_size=4, seed=0, epochs=1,
+                shuffle=False, max_bad_records=max_bad, **kw)
+            return [int(x) for b in pipe for x in b["label"]]
+
+        good = [i for i in range(12) if i != 7][:8]
+        modes = [dict(), dict(slab_cache_dir=str(tmp_path / "c"))]
+        if decode_plane.available():
+            modes.append(dict(decode_workers=2))
+        for kw in modes:
+            before = _counter("data_records_skipped_total")
+            assert labels(1, **kw) == good, kw
+            assert _counter("data_records_skipped_total") == before + 1, kw
+            with pytest.raises(Exception):
+                labels(0, **kw)
+        # and PIL-forced threads charge the same record
+        monkeypatch.setenv(native_io.DECODE_ENV_VAR, "0")
+        before = _counter("data_records_skipped_total")
+        assert labels(1) == good
+        assert _counter("data_records_skipped_total") == before + 1
+
+    def test_readahead_auto_stream_is_identical(self, jpeg_shards):
+        base = _jstream(jpeg_shards)
+        assert _jstream(jpeg_shards, readahead="auto") == base
+        assert "readahead_depth" in obs.snapshot()["gauges"]
+
+
+class TestChaosCacheAndReadahead:
+    pytestmark = pytest.mark.chaos
+
+    @pytest.fixture(autouse=True)
+    def _clean_chaos(self):
+        chaos.uninstall()
+        yield
+        chaos.uninstall()
+
+    def test_cache_tear_is_rejected_and_stream_survives(self, jpeg_shards, tmp_path):
+        # a torn commit (crash between manifest write and fsync) must be
+        # rejected by verify-on-publish — the records decode again, the
+        # stream never sees garbage
+        base = _jstream(jpeg_shards, epochs=2)
+        cache = str(tmp_path / "slab-cache")
+        plan = chaos.ChaosPlan(seed=0).site(
+            "data.cache_tear", probability=1.0, max_count=1
+        )
+        chaos.install(plan, propagate=False)
+        rejects = _counter("decode_cache_rejects_total")
+        hits = _counter("decode_cache_hits_total")
+        assert _jstream(jpeg_shards, epochs=2, slab_cache_dir=cache) == base
+        assert plan.fired("data.cache_tear") == 1
+        assert _counter("decode_cache_rejects_total") - rejects == 1
+        # epoch 1's torn generation served nothing: epoch 2 re-decoded
+        assert _counter("decode_cache_hits_total") == hits
+        # the epoch-2 commit was past the chaos budget: a fresh run hits
+        chaos.uninstall()
+        assert _jstream(jpeg_shards, slab_cache_dir=cache) == base[: len(base) // 2]
+        assert _counter("decode_cache_hits_total") - hits == 59  # 60 - bootstrap
+
+    def test_readahead_stall_only_slows_the_stream(self, jpeg_shards):
+        base = _jstream(jpeg_shards)
+        plan = chaos.ChaosPlan(seed=0).site(
+            "data.readahead_stall", probability=1.0, max_count=3, delay_s=0.01
+        )
+        chaos.install(plan, propagate=False)
+        read_before = _counter("data_producer_read_seconds_total")
+        assert _jstream(jpeg_shards, readahead="auto") == base
+        assert plan.fired("data.readahead_stall") == 3
+        # the stall is charged to shard-read time, where the readahead
+        # autotuner and classify_stalls can see it
+        assert _counter("data_producer_read_seconds_total") - read_before >= 0.03
