@@ -925,6 +925,86 @@ def bench_serving(tiny):
         finally:
             srv.stop()
 
+    def run_mesh_leg():
+        """Load ramp against a 3-replica mesh with a mid-ramp replica kill:
+        per-stage p50/p99/shed-rate, plus the post-kill tail and error count
+        (the survivability headline: failover should absorb the SIGKILL)."""
+        from tensorflowonspark_tpu import chaos
+        from tensorflowonspark_tpu.serving_mesh import ServingMesh
+
+        n_replicas = int(os.environ.get("BENCH_MESH_REPLICAS", "3"))
+        ramp = [max(1, n_clients // 4), max(2, n_clients // 2), n_clients]
+        stage_reqs = max(2, reqs_per_client // (1 if tiny else 2))
+        mesh = ServingMesh(bundle, replicas=n_replicas, mode="thread",
+                           monitor_interval=0.5)
+        mesh.start()
+        router = mesh.router()
+        stages = []
+        try:
+            router.predict_binary(image=image)  # warm each side of the flip
+
+            def run_stage(clients_n):
+                lat, shed, errors = [], [0], [0]
+                lat_lock = threading.Lock()
+
+                def worker():
+                    mine, my_shed, my_err = [], 0, 0
+                    for _ in range(stage_reqs):
+                        t0 = _time.perf_counter()
+                        try:
+                            out = router.predict_binary(image=image)
+                            mine.append(_time.perf_counter() - t0)
+                            assert out["prediction"].shape == (batch,)
+                        except RuntimeError as e:
+                            if "Overloaded" in str(e) or "DeadlineExceeded" in str(e):
+                                my_shed += 1
+                            else:
+                                my_err += 1
+                        except OSError:
+                            my_err += 1
+                    with lat_lock:
+                        lat.extend(mine)
+                        shed[0] += my_shed
+                        errors[0] += my_err
+
+                threads = [threading.Thread(target=worker) for _ in range(clients_n)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                lat.sort()
+                total = clients_n * stage_reqs
+                return {
+                    "clients": clients_n,
+                    "p50_ms": 1e3 * lat[len(lat) // 2] if lat else 0.0,
+                    "p99_ms": 1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))] if lat else 0.0,
+                    "shed_rate": shed[0] / total if total else 0.0,
+                    "errors": errors[0],
+                }
+
+            stages.append(run_stage(ramp[0]))
+            # mid-ramp: SIGKILL one replica; the monitor fires the site on
+            # its next tick while the remaining stages keep the load up
+            chaos.install(
+                chaos.ChaosPlan(seed=11).site(
+                    "serving.replica_kill", probability=1.0, max_count=1
+                )
+            )
+            try:
+                post_kill = [run_stage(n) for n in ramp[1:]]
+            finally:
+                chaos.uninstall()
+            stages.extend(post_kill)
+            return {
+                "replicas": n_replicas,
+                "stages": stages,
+                "post_kill_p99_ms": max(s["p99_ms"] for s in post_kill),
+                "post_kill_errors": sum(s["errors"] for s in post_kill),
+            }
+        finally:
+            router.close()
+            mesh.stop()
+
     on, off, bounded = [], [], []
     for _ in range(rounds):  # interleaved A/B/C
         on.append(run_leg(True))
@@ -932,6 +1012,19 @@ def bench_serving(tiny):
         # the r5 tail policy: p99 of SERVED requests is bounded by the
         # per-request deadline (+ one in-flight dispatch); sheds error fast
         bounded.append(run_leg(True, deadline=True))
+    mesh_leg = run_mesh_leg()
+    print(
+        "serving mesh ({} replicas, mid-ramp replica_kill): ".format(
+            mesh_leg["replicas"]
+        )
+        + " | ".join(
+            "{} clients: p50 {:.0f} ms p99 {:.0f} ms shed {:.1%} err {}".format(
+                s["clients"], s["p50_ms"], s["p99_ms"], s["shed_rate"], s["errors"]
+            )
+            for s in mesh_leg["stages"]
+        ),
+        file=sys.stderr,
+    )
     def med(legs, k):
         return statistics.median(leg[k] for leg in legs)
     for name, legs in (
@@ -957,6 +1050,7 @@ def bench_serving(tiny):
             n_clients, batch, med(on, "p50_ms"), med(on, "p99_ms")
         ),
         "vs_baseline": round(med(on, "rows_per_sec") / med(off, "rows_per_sec"), 2),
+        "mesh": mesh_leg,
     }
 
 

@@ -38,6 +38,7 @@ MODULES = [
     "tensorflowonspark_tpu.marker",
     "tensorflowonspark_tpu.shm",
     "tensorflowonspark_tpu.serving",
+    "tensorflowonspark_tpu.serving_mesh",
     "tensorflowonspark_tpu.compat",
     "tensorflowonspark_tpu.util",
     "tensorflowonspark_tpu.resilience",

@@ -122,6 +122,13 @@ if [[ "$CHAOS" == "1" ]]; then
   # benign control.lease_delay run. Asserted from merged cluster metrics.
   echo "chaos leg: control.driver_crash registry-recovery run"
   python -m pytest tests/test_chaos_control.py -q -m "chaos and slow"
+  # serving-mesh leg (self-installed plan): serving.replica_kill SIGKILLs
+  # one of three replicas under sustained client load — the router must
+  # fail every affected request over (cluster.metrics() shows
+  # serving_failovers_total > 0) with zero client-visible errors, the
+  # replicas_active gauge dips and recovers, and the dead lease expires.
+  echo "chaos leg: serving.replica_kill mesh-failover run"
+  python -m pytest tests/test_chaos_mesh.py -q -m "chaos and slow"
   # Benign-in-outcome sites at low probability: the suite's assertions
   # must keep passing — most sites only perturb timing; data.decode_kill
   # SIGKILLs a decode worker, which the plane's respawn-and-release

@@ -105,6 +105,18 @@ site                            effect at the injection point
 ``serving.latency``             predictor sleeps before dispatch
 ``serving.conn_drop``           server closes the connection mid-request
 ``serving.overload``            submit sheds with ``Overloaded``
+``serving.replica_kill``        mesh monitor SIGKILLs a serving replica
+                                mid-load (``victim: <rid>`` targets one);
+                                the router fails requests over and the
+                                monitor relaunches it
+``serving.router_partition``    router loses a replica's connection: the
+                                pooled client is dropped and the attempt
+                                raises ``ConnectionResetError``, driving
+                                failover and the replica's circuit breaker
+``serving.swap_torn``           model-generation publish commits a torn
+                                manifest; replicas must reject the swap via
+                                ``manifest.verify()`` and keep serving the
+                                old bundle
 ``native_io.read_fail``         TFRecord read raises ``IOError``
 ==============================  ==============================================
 """

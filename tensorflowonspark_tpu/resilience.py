@@ -290,9 +290,11 @@ class CircuitBreaker:
 
     Closed (normal) → ``failure_threshold`` consecutive failures open the
     circuit → calls fail fast with :class:`CircuitOpenError` for
-    ``reset_timeout`` seconds → the next call is admitted as a half-open
-    probe — success closes the circuit, failure reopens it (and restarts
-    the timer). Thread-safe; the clock is injectable for tests.
+    ``reset_timeout`` seconds → the next :meth:`allow` admits exactly ONE
+    half-open trial call (concurrent callers keep failing fast until the
+    trial resolves) — success closes the circuit, failure reopens it (and
+    restarts the timer, without re-counting the trip). Thread-safe; the
+    clock is injectable for tests.
     """
 
     def __init__(self, failure_threshold=5, reset_timeout=30.0, clock=time.monotonic, name=None):
@@ -306,6 +308,8 @@ class CircuitBreaker:
         self._state = CLOSED
         self._failures = 0
         self._opened_at = None
+        #: True while the single half-open trial call is outstanding
+        self._probe_in_flight = False
 
     @property
     def state(self):
@@ -317,6 +321,7 @@ class CircuitBreaker:
         # caller holds the lock
         if self._state == OPEN and self._clock() - self._opened_at >= self.reset_timeout:
             self._state = HALF_OPEN
+            self._probe_in_flight = False
             obs.counter(
                 "circuit_half_open_total",
                 help="circuit breaker open -> half-open transitions",
@@ -324,22 +329,37 @@ class CircuitBreaker:
 
     def allow(self):
         """True if a call may proceed (transitions open → half-open when
-        the reset timeout has elapsed)."""
+        the reset timeout has elapsed). In HALF_OPEN, exactly one caller is
+        admitted as the trial request — the admitting ``allow()`` consumes
+        the probe token; concurrent probes are refused until the trial
+        reports through :meth:`record_success` / :meth:`record_failure`."""
         with self._lock:
             self._maybe_half_open()
-            return self._state != OPEN
+            if self._state == OPEN:
+                return False
+            if self._state == HALF_OPEN:
+                if self._probe_in_flight:
+                    return False
+                self._probe_in_flight = True
+            return True
 
     def record_success(self):
         with self._lock:
             self._state = CLOSED
             self._failures = 0
             self._opened_at = None
+            self._probe_in_flight = False
 
     def record_failure(self):
         with self._lock:
             self._maybe_half_open()
             if self._state == HALF_OPEN:
                 self._trip()
+                return
+            if self._state == OPEN:
+                # a straggler reporting after the circuit already opened
+                # (e.g. the losing half of a hedged pair): already counted,
+                # no second trip
                 return
             self._failures += 1
             if self._failures >= self.failure_threshold:

@@ -152,6 +152,10 @@ class _Predictor:
         #: majority-signature load
         self._backlog = collections.deque()
         self._stopped = False
+        #: newest request's column signature — what a hot-swap warm-up
+        #: predict should look like (serving_mesh warms the new compile off
+        #: the request path before flipping)
+        self._last_spec = None
         self._submit_lock = threading.Lock()
         self._requests_c = obs.counter(
             "serving_requests_total", help="predict requests submitted (shed ones included)"
@@ -187,6 +191,7 @@ class _Predictor:
         if not arrays:
             raise ValueError("predict requires at least one input column")
         lead = set()
+        spec = []
         for name, arr in arrays.items():
             arr = np.asarray(arr)
             if arr.ndim == 0:
@@ -195,6 +200,7 @@ class _Predictor:
                     "(row) dimension".format(name)
                 )
             lead.add(arr.shape[0])
+            spec.append((name, arr.dtype.str, tuple(arr.shape[1:])))
         if len(lead) != 1:
             raise ValueError("input columns disagree on row count: {}".format(sorted(lead)))
 
@@ -209,6 +215,7 @@ class _Predictor:
         # wins the race enqueues BEFORE the sentinel (the run thread serves
         # it), one that loses raises — no future can be orphaned
         with self._submit_lock:
+            self._last_spec = tuple(sorted(spec))
             if self._stopped:
                 raise RuntimeError("predictor stopped")
             # _pending counts every unresolved request — queued, parked in
@@ -235,6 +242,13 @@ class _Predictor:
         with self._submit_lock:
             self._pending -= 1
             self._pending_g.set(self._pending)
+
+    def warm_spec(self):
+        """Column signature of the newest submitted request — sorted
+        ``(name, dtype, trailing shape)`` triples, or None before the first
+        request."""
+        with self._submit_lock:
+            return self._last_spec
 
     def stop(self):
         with self._submit_lock:
@@ -408,23 +422,22 @@ class _Predictor:
                     start += n
 
 
-class InferenceServer:
-    """Serve one exported model bundle over TCP.
+class ProtocolServer:
+    """Socket/accept/connection machinery for the wire protocol in the
+    module docstring, decoupled from where predictions actually run.
+    Subclasses supply ``_submit(arrays) -> outputs`` (dict of numpy arrays
+    in and out) and ``_info() -> dict``: :class:`InferenceServer` plugs in
+    a local :class:`_Predictor`; the mesh frontend
+    (:class:`~tensorflowonspark_tpu.serving_mesh.MeshFrontend`) plugs in a
+    replica router.
 
     Connections are handled by a bounded thread pool
     (``TOS_SERVING_THREADS``, default 32) instead of round 2's unbounded
-    thread-per-connection; predictions funnel through the coalescing
-    :class:`_Predictor`."""
+    thread-per-connection."""
 
-    def __init__(self, export_dir, host="", port=0, max_threads=None, trusted_builder=None):
-        from tensorflowonspark_tpu.train import export
-
-        self.export_dir = export_dir
-        predict_fn, params, model_state = export.load_model(
-            export_dir, trusted_builder=trusted_builder
-        )
-        self._predictor = _Predictor(predict_fn, params, model_state)
+    def __init__(self, host="", port=0, max_threads=None, name="tos-serving"):
         self._max_threads = max_threads or int(os.environ.get("TOS_SERVING_THREADS", "32"))
+        self._name = name
         self._pool = None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -443,11 +456,13 @@ class InferenceServer:
         from concurrent.futures import ThreadPoolExecutor
 
         self._pool = ThreadPoolExecutor(
-            max_workers=self._max_threads, thread_name_prefix="tos-serving"
+            max_workers=self._max_threads, thread_name_prefix=self._name
         )
-        self._thread = threading.Thread(target=self._serve, name="tos-serving-accept", daemon=True)
+        self._thread = threading.Thread(
+            target=self._serve, name=self._name + "-accept", daemon=True
+        )
         self._thread.start()
-        logger.info("inference server for %s at %s", self.export_dir, self.address)
+        logger.info("%s listening at %s", self._name, self.address)
         return self.address
 
     def stop(self):
@@ -470,13 +485,48 @@ class InferenceServer:
                 conn.close()
             except OSError:
                 pass
-        self._predictor.stop()
+        self._stop_workload()
         if self._pool is not None:
             self._pool.shutdown(wait=True)
         try:
             self._sock.close()
         except OSError:
             pass
+
+    def kill(self):
+        """SIGKILL-shaped death for chaos tests: close the listening socket
+        and every live connection with no drain — in-flight requests see a
+        connection reset, exactly what a killed process produces.
+        :meth:`stop` may still be called afterwards to reap threads."""
+        self._shutdown.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- subclass surface ------------------------------------------------------
+
+    def _submit(self, arrays):
+        """Run one predict (dict of numpy arrays -> dict of numpy arrays)."""
+        raise NotImplementedError
+
+    def _info(self):
+        return {"type": "info", "ready": True}
+
+    def _stop_workload(self):
+        """Hook: drain subclass-owned work after connections close and
+        before the handler pool shuts down."""
 
     # -- internals ------------------------------------------------------------
 
@@ -540,7 +590,7 @@ class InferenceServer:
             raise ConnectionError("client closed mid-request")
         try:
             arrays = _columns_to_arrays(msg.get("columns") or [], payload)
-            outputs = self._predictor.submit(arrays)
+            outputs = self._submit(arrays)
             columns, out_payload = _arrays_to_columns(outputs)
         except (Overloaded, DeadlineExceeded) as e:
             # expected under load-shedding policy: no traceback spam
@@ -559,7 +609,7 @@ class InferenceServer:
         if kind == "ping":
             return {"type": "pong"}
         if kind == "info":
-            return {"type": "info", "export_dir": self.export_dir, "ready": True}
+            return self._info()
         if kind == "predict":
             try:
                 return {"type": "result", "outputs": self._predict(msg.get("inputs") or {})}
@@ -575,8 +625,59 @@ class InferenceServer:
         import numpy as np
 
         arrays = {name: np.asarray(vals) for name, vals in inputs.items()}
-        outputs = self._predictor.submit(arrays)
+        outputs = self._submit(arrays)
         return {name: np.asarray(v).tolist() for name, v in outputs.items()}
+
+
+class InferenceServer(ProtocolServer):
+    """Serve one exported model bundle over TCP.
+
+    Predictions funnel through the coalescing :class:`_Predictor`. The
+    predictor slot is hot-swappable: :meth:`swap_predictor` installs a new
+    one atomically (the serving mesh's zero-downtime model swap) while
+    requests already dispatched drain on the old one."""
+
+    def __init__(self, export_dir, host="", port=0, max_threads=None, trusted_builder=None):
+        from tensorflowonspark_tpu.train import export
+
+        self.export_dir = export_dir
+        predict_fn, params, model_state = export.load_model(
+            export_dir, trusted_builder=trusted_builder
+        )
+        self._pred_lock = threading.Lock()
+        self._predictor = _Predictor(predict_fn, params, model_state)
+        ProtocolServer.__init__(self, host=host, port=port, max_threads=max_threads)
+
+    def swap_predictor(self, predictor, export_dir=None):
+        """Atomically install ``predictor`` (zero-downtime hot swap) and
+        return the old one. Requests already dispatched keep draining on
+        the old predictor; the caller stops it after the flip."""
+        with self._pred_lock:
+            old = self._predictor
+            self._predictor = predictor
+            if export_dir is not None:
+                self.export_dir = export_dir
+        return old
+
+    def warm_spec(self):
+        """Column signature of the newest request seen by the current
+        predictor — what a hot-swap warm-up predict should look like."""
+        with self._pred_lock:
+            predictor = self._predictor
+        return predictor.warm_spec()
+
+    def _submit(self, arrays):
+        with self._pred_lock:
+            predictor = self._predictor
+        return predictor.submit(arrays)
+
+    def _info(self):
+        return {"type": "info", "export_dir": self.export_dir, "ready": True}
+
+    def _stop_workload(self):
+        with self._pred_lock:
+            predictor = self._predictor
+        predictor.stop()
 
 
 class InferenceClient:
@@ -637,8 +738,37 @@ class InferenceClient:
             raise ConnectionError("inference server closed the connection")
         return self._check_reply(reply)
 
+    def _call(self, fn, *args):
+        """Run a protocol roundtrip under the retry policy. When the budget
+        is exhausted, the final error NAMES the server address, attempt
+        count, and elapsed budget (the contract the reservation client's
+        driver-restart path set) instead of surfacing the bare last error."""
+        import time as _time
+
+        started = _time.monotonic()
+        try:
+            return self._policy.call(fn, *args)
+        except Overloaded as e:
+            elapsed = _time.monotonic() - started
+            raise Overloaded(
+                "Overloaded: inference server at {}:{} kept shedding after {} "
+                "attempt(s) over {:.1f}s: {}".format(
+                    self.address[0] or "127.0.0.1", self.address[1],
+                    self._policy.max_attempts, elapsed, e,
+                )
+            ) from e
+        except (OSError, resilience.DeadlineExceeded) as e:
+            elapsed = _time.monotonic() - started
+            raise ConnectionError(
+                "inference server at {}:{} unreachable after {} attempt(s) "
+                "over {:.1f}s: {}".format(
+                    self.address[0] or "127.0.0.1", self.address[1],
+                    self._policy.max_attempts, elapsed, e,
+                )
+            ) from e
+
     def _request(self, msg):
-        return self._policy.call(self._roundtrip, msg)
+        return self._call(self._roundtrip, msg)
 
     def ping(self):
         return self._request({"type": "ping"})["type"] == "pong"
@@ -681,7 +811,7 @@ class InferenceClient:
                 raise
             return _columns_to_arrays(reply["columns"], out_payload)
 
-        return self._policy.call(_round)
+        return self._call(_round)
 
     def close(self):
         self._reset()
@@ -813,6 +943,22 @@ def run_batch_inference(
     return total
 
 
+#: set by :func:`_wait_for_exit` while a blocking ``main()`` is serving;
+#: tests set it to shut the CLI down as cleanly as a Ctrl-C would
+_exit_event = None
+
+
+def _wait_for_exit():
+    global _exit_event
+    _exit_event = threading.Event()
+    try:
+        _exit_event.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        _exit_event = None
+
+
 def main(argv=None):
     import sys
 
@@ -841,6 +987,26 @@ def main(argv=None):
              "bundle's pickle; with npz weights, nothing from --export_dir "
              "is unpickled (safe for untrusted storage). Without this flag "
              "the bundle is TRUSTED: loading it executes its pickled code.")
+
+    mesh_p = sub.add_parser(
+        "mesh", help="serve N replicas behind one routed, hedging endpoint"
+    )
+    mesh_p.add_argument("--export_dir", required=True,
+                        help="bundle dir or serving_mesh generation-pointer dir")
+    mesh_p.add_argument("--replicas", type=int, default=3)
+    mesh_p.add_argument("--host", default="")
+    mesh_p.add_argument("--port", type=int, default=8500,
+                        help="the routed frontend's port (replicas bind ephemeral ports)")
+    mesh_p.add_argument(
+        "--metrics_port", type=int, default=0, metavar="PORT",
+        help="serve Prometheus metrics on this port; the snapshot includes "
+             "the mesh gauges (serving_replicas_active etc.), so scraping "
+             "any mesh process shows replica health; 0 disables")
+    mesh_p.add_argument("--hedge_ms", type=float, default=0.0,
+                        help="hedge a request to a second replica when the first "
+                             "has not answered within this many ms; 0 disables")
+    mesh_p.add_argument("--trusted_builder", default=None, metavar="MODULE:ATTR",
+                        help="safe-load lane for --export_dir (see serve --help)")
 
     infer_p = sub.add_parser("infer", help="batch inference: TFRecords -> prediction shards")
     infer_p.add_argument("--tfrecords", required=True, help="input TFRecord shard dir")
@@ -883,6 +1049,50 @@ def main(argv=None):
         print(json.dumps({"inferred": total, "output": args.output}), flush=True)
         return
 
+    if args.command == "mesh":
+        from tensorflowonspark_tpu import serving_mesh
+
+        mesh = serving_mesh.ServingMesh(
+            args.export_dir, replicas=args.replicas,
+            trusted_builder=args.trusted_builder,
+        )
+        mesh.start()
+        router = mesh.router(
+            hedge_after=args.hedge_ms / 1000.0 if args.hedge_ms > 0 else None
+        )
+        front = serving_mesh.MeshFrontend(router, host=args.host, port=args.port)
+        host, port = front.start()
+        metrics_server = None
+        if args.metrics_port:
+            from tensorflowonspark_tpu.obs import exporter
+
+            # the process-global snapshot carries the mesh gauges
+            # (serving_replicas_active, failover/hedge/swap counters), so a
+            # scrape of this endpoint shows mesh health, not just one replica
+            metrics_server = exporter.MetricsHTTPServer(
+                obs.snapshot, host=args.host, port=args.metrics_port
+            ).start()
+        print(
+            json.dumps(
+                {
+                    "serving": args.export_dir,
+                    "mesh": True,
+                    "replicas": args.replicas,
+                    "host": host or "0.0.0.0",
+                    "port": port,
+                    "metrics_port": metrics_server.address[1] if metrics_server else None,
+                }
+            ),
+            flush=True,
+        )
+        _wait_for_exit()
+        if metrics_server is not None:
+            metrics_server.stop()
+        front.stop()
+        router.close()
+        mesh.stop()
+        return
+
     server = InferenceServer(
         args.export_dir, args.host, args.port, trusted_builder=args.trusted_builder
     )
@@ -905,12 +1115,10 @@ def main(argv=None):
         ),
         flush=True,
     )
-    try:
-        threading.Event().wait()
-    except KeyboardInterrupt:
-        if metrics_server is not None:
-            metrics_server.stop()
-        server.stop()
+    _wait_for_exit()
+    if metrics_server is not None:
+        metrics_server.stop()
+    server.stop()
 
 
 if __name__ == "__main__":

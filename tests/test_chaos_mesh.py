@@ -1,0 +1,203 @@
+"""Serving-mesh chaos (ISSUE 13): router partitions, torn swap publishes,
+and the ``run_tests.sh --chaos`` replica-kill leg — SIGKILL one of three
+replicas under sustained load, assert via the merged ``cluster.metrics()``
+that failover absorbed it (``serving_failovers_total > 0``) with zero
+client-visible request failures, the active-replica gauge dipped and
+recovered, and the dead replica's lease expired in the registry."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import TFCluster, chaos, obs, resilience
+from tensorflowonspark_tpu.TFCluster import InputMode
+from tensorflowonspark_tpu.backends.local import LocalSparkContext
+from tensorflowonspark_tpu.serving import InferenceServer
+from tensorflowonspark_tpu.serving_mesh import ModelPointer, ReplicaServer, ServingMesh
+from tensorflowonspark_tpu.train import export
+
+pytestmark = pytest.mark.chaos
+
+CPU_ENV = {"JAX_PLATFORMS": "cpu"}
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def _builder():
+    def predict(params, model_state, arrays):
+        return {"y_": arrays["x"] @ params["w"]}
+
+    return predict
+
+
+def _params(scale):
+    return {"w": np.full((1, 1), float(scale), np.float32)}
+
+
+def _bundle(path, scale):
+    export.export_model(str(path), _builder, _params(scale))
+    return str(path)
+
+
+def _counter(name):
+    return obs.snapshot()["counters"].get(name, {}).get("value", 0)
+
+
+def fn_sleep_forever(args, ctx):
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        feed.next_batch(16)
+
+
+class TestRouterPartition:
+    def test_partition_drives_failover_not_an_error(self, tmp_path):
+        """``serving.router_partition`` drops the chosen replica's pooled
+        connection mid-route; the request must fail over and succeed."""
+        a = InferenceServer(_bundle(tmp_path / "a", 3))
+        b = InferenceServer(_bundle(tmp_path / "b", 3))
+        a.start()
+        b.start()
+        plan = chaos.ChaosPlan(seed=4).site(
+            "serving.router_partition", probability=1.0, max_count=1
+        )
+        chaos.install(plan, propagate=False)
+        failovers = _counter("serving_failovers_total")
+        from tensorflowonspark_tpu.serving_mesh import ReplicaRouter
+
+        router = ReplicaRouter(
+            {0: a.address, 1: b.address}, deadline=10.0, breaker_threshold=5,
+            backoff=resilience.Backoff(base=0.02, factor=2.0, max_delay=0.1,
+                                       jitter=0.5, seed=0),
+        )
+        try:
+            out = router.predict_binary(x=np.ones((1, 1), np.float32))
+            assert float(np.asarray(out["y_"]).ravel()[0]) == 3.0
+            assert plan.fired("serving.router_partition") == 1
+            assert _counter("serving_failovers_total") - failovers >= 1
+        finally:
+            router.close()
+            a.stop()
+            b.stop()
+
+
+class TestSwapTorn:
+    def test_torn_publish_rejected_mesh_keeps_serving(self, tmp_path):
+        """``serving.swap_torn`` tears the manifest of a fresh generation;
+        the replica rejects it via cheap-verify and the old model serves."""
+        pointer = ModelPointer(str(tmp_path / "ptr"))
+        pointer.publish(_builder, _params(2))
+        rep = ReplicaServer(pointer.root, poll_interval=999)
+        rep.start()
+        rejects = _counter("serving_swap_rejects_total")
+        plan = chaos.ChaosPlan(seed=6).site(
+            "serving.swap_torn", probability=1.0, max_count=1
+        )
+        chaos.install(plan, propagate=False)
+        try:
+            pointer.publish(_builder, _params(8))
+            assert plan.fired("serving.swap_torn") == 1
+            assert rep.check_swap() is False
+            assert _counter("serving_swap_rejects_total") - rejects == 1
+            assert rep.generation() == "gen-000000"
+        finally:
+            rep.stop()
+
+
+@pytest.mark.slow
+def test_replica_kill_under_load_no_client_visible_failure(tmp_path, monkeypatch):
+    """The ``run_tests.sh --chaos`` mesh leg (ISSUE 13 acceptance): SIGKILL
+    one of three process replicas under sustained load. Every request
+    completes via failover (zero client-visible errors), the merged
+    ``cluster.metrics()`` shows ``serving_failovers_total > 0``,
+    ``serving_replicas_active`` dips then recovers on relaunch, and the dead
+    replica's lease expires in the mesh registry."""
+    chaos_log = str(tmp_path / "chaos.log")
+    monkeypatch.setenv(chaos.LOG_ENV_VAR, chaos_log)
+
+    sc = LocalSparkContext(num_executors=2, task_timeout=240)
+    mesh = router = None
+    stop = threading.Event()
+    try:
+        cluster = TFCluster.run(
+            sc, fn_sleep_forever, {}, num_executors=2,
+            input_mode=InputMode.SPARK, master_node=None,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
+        )
+        # the mesh lives driver-side: its metrics ride the driver's
+        # process-global registry into the merged cluster.metrics() view
+        mesh = ServingMesh(
+            _bundle(tmp_path / "bundle", 3), replicas=3, mode="process",
+            monitor_interval=0.5, lease_ttl=2.0,
+        )
+        mesh.start()
+        router = mesh.router(deadline=30.0)
+        expiries = _counter("registry_lease_expirations_total")
+        relaunches = _counter("serving_replica_relaunches_total")
+        errors = []
+        min_active = [99]
+
+        def load():
+            while not stop.is_set():
+                try:
+                    out = router.predict_binary(x=np.ones((1, 1), np.float32))
+                    assert float(np.asarray(out["y_"]).ravel()[0]) == 3.0
+                except Exception as e:  # any client-visible failure fails the leg
+                    errors.append(e)
+                g = obs.snapshot()["gauges"].get("serving_replicas_active")
+                if g is not None:
+                    min_active[0] = min(min_active[0], g["value"])
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=load) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # load is flowing before the fault lands
+        chaos.install(
+            chaos.ChaosPlan(seed=13).site(
+                "serving.replica_kill", probability=1.0, max_count=1
+            ),
+            propagate=False,
+        )
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if (
+                _counter("serving_replica_relaunches_total") - relaunches >= 1
+                and len(mesh.endpoints()) == 3
+            ):
+                break
+            time.sleep(0.5)
+        time.sleep(1.0)  # settled load on the recovered mesh
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+
+        assert not errors, errors[:3]
+        assert _counter("serving_replica_relaunches_total") - relaunches >= 1
+        assert _counter("registry_lease_expirations_total") - expiries >= 1
+        assert min_active[0] <= 2  # the gauge dip was observable
+        assert len(mesh.endpoints()) == 3
+
+        snap = cluster.metrics()
+        assert snap["counters"]["serving_failovers_total"]["value"] > 0
+        assert snap["gauges"]["serving_replicas_active"]["value"] == 3
+
+        cluster.shutdown(timeout=120)
+    finally:
+        stop.set()
+        if router is not None:
+            router.close()
+        if mesh is not None:
+            mesh.stop()
+        sc.stop()
+        chaos.uninstall()
+
+    with open(chaos_log) as f:
+        fired = [line.strip() for line in f]
+    assert "serving.replica_kill" in fired

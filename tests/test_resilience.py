@@ -313,3 +313,72 @@ class TestCircuitBreaker:
         cb = self._breaker(now)
         assert cb.call(lambda: "ok") == "ok"
         assert cb.state == resilience.CLOSED
+
+    def _open_then_half_open(self, now):
+        cb = self._breaker(now)
+        cb.record_failure()
+        cb.record_failure()
+        now[0] = 10.0
+        assert cb.state == resilience.HALF_OPEN
+        return cb
+
+    def test_half_open_admits_exactly_one_probe(self):
+        now = [0.0]
+        cb = self._open_then_half_open(now)
+        assert cb.allow()          # the single trial request
+        assert not cb.allow()      # a concurrent caller is refused
+        assert not cb.allow()
+        # reading the state must NOT consume or grant probe tokens
+        assert cb.state == resilience.HALF_OPEN
+        assert not cb.allow()
+        cb.record_success()
+        assert cb.state == resilience.CLOSED
+        assert cb.allow()
+
+    def test_half_open_concurrent_probes_race_one_winner(self):
+        import threading
+
+        now = [0.0]
+        cb = self._open_then_half_open(now)
+        admitted = []
+        barrier = threading.Barrier(8)
+
+        def probe():
+            barrier.wait()
+            if cb.allow():
+                admitted.append(1)
+
+        threads = [threading.Thread(target=probe) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(admitted) == 1  # exactly one trial passed
+
+    def test_failed_probe_reopens_without_double_counting_trips(self):
+        from tensorflowonspark_tpu import obs
+
+        def trips():
+            return obs.snapshot()["counters"].get(
+                "circuit_open_total", {}
+            ).get("value", 0)
+
+        now = [0.0]
+        before = trips()
+        cb = self._open_then_half_open(now)
+        assert trips() - before == 1  # the original trip
+        assert cb.allow()
+        cb.record_failure()  # the trial failed: re-open, count ONE more trip
+        assert cb.state == resilience.OPEN
+        assert trips() - before == 2
+        # stragglers reporting after the re-open (a losing hedge sibling, a
+        # refused concurrent probe's caller) must not re-trip
+        cb.record_failure()
+        cb.record_failure()
+        assert trips() - before == 2
+        # and the restarted timer admits a fresh single probe
+        now[0] = 20.0
+        assert cb.allow()
+        assert not cb.allow()
+        cb.record_success()
+        assert cb.state == resilience.CLOSED

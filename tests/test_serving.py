@@ -192,6 +192,36 @@ def test_concurrent_clients_all_served(server):
     assert len(results) == 12
 
 
+def test_client_final_error_names_server_and_budget(tmp_path):
+    """After retry exhaustion the client's error must name the server
+    address, attempt count, and elapsed budget — the reservation.Client
+    contract — not surface the bare last OSError."""
+    from tensorflowonspark_tpu import resilience
+
+    srv = InferenceServer(_bundle(tmp_path))
+    host, port = srv.start()
+    client = InferenceClient(
+        (host, port), timeout=5,
+        retry=resilience.RetryPolicy(
+            max_attempts=2,
+            backoff=resilience.Backoff(base=0.02, factor=2.0, max_delay=0.1,
+                                       jitter=0.5, seed=0),
+            retry_on=(OSError,),
+        ),
+    )
+    srv.stop()
+    try:
+        with pytest.raises(ConnectionError) as err:
+            client.predict(x=[[1.0, 2.0]])
+    finally:
+        client.close()
+    msg = str(err.value)
+    assert "inference server at {}:{}".format(host or "127.0.0.1", port) in msg
+    assert "2 attempt(s)" in msg
+    assert "unreachable" in msg
+    assert err.value.__cause__ is not None  # the bare last error is chained
+
+
 def test_stop_with_idle_persistent_connection(tmp_path):
     """stop() must complete even while a client holds an idle persistent
     connection (pool threads are non-daemon; the server closes live

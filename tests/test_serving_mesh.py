@@ -1,0 +1,489 @@
+"""Serving mesh (ISSUE 13): replica leases, routed failover, hedging, and
+zero-downtime hot swap.
+
+Router tests run against static endpoint dicts and plain
+``InferenceServer``s so each behavior (round-robin, failover, circuit shed,
+hedging, final-error naming) is isolated; the mesh lifecycle and hot-swap
+tests run a real thread-mode :class:`ServingMesh` with short lease TTLs so
+kill → lease expiry → relaunch happens inside a few monitor ticks."""
+
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import chaos, obs, resilience, serving
+from tensorflowonspark_tpu.ckpt import manifest
+from tensorflowonspark_tpu.serving import InferenceClient, InferenceServer, Overloaded
+from tensorflowonspark_tpu.serving_mesh import (
+    MeshFrontend,
+    ModelPointer,
+    ReplicaRouter,
+    ReplicaServer,
+    ServingMesh,
+)
+from tensorflowonspark_tpu.train import export
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def _builder():
+    def predict(params, model_state, arrays):
+        return {"y_": arrays["x"] @ params["w"]}
+
+    return predict
+
+
+def _params(scale):
+    return {"w": np.full((1, 1), float(scale), np.float32)}
+
+
+def _bundle(path, scale):
+    export.export_model(str(path), _builder, _params(scale))
+    return str(path)
+
+
+def _counter(name):
+    return obs.snapshot()["counters"].get(name, {}).get("value", 0)
+
+
+def _gauge(name):
+    return obs.snapshot()["gauges"].get(name, {}).get("value", 0)
+
+
+def _value(out):
+    return float(np.asarray(out["y_"]).ravel()[0])
+
+
+def _fast_router(endpoints, **kw):
+    kw.setdefault("deadline", 10.0)
+    kw.setdefault("backoff", resilience.Backoff(base=0.02, factor=2.0,
+                                                max_delay=0.1, jitter=0.5, seed=0))
+    return ReplicaRouter(endpoints, **kw)
+
+
+def _dead_port():
+    """A port nothing listens on (bound once, then released)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestModelPointer:
+    def test_publish_flips_pointer_atomically(self, tmp_path):
+        pointer = ModelPointer(str(tmp_path / "ptr"))
+        assert pointer.current() is None
+        gen0 = pointer.publish(_builder, _params(2))
+        assert pointer.current() == ("gen-000000", gen0)
+        ok, reason = manifest.verify(gen0)
+        assert ok, reason
+        gen1 = pointer.publish(_builder, _params(5))
+        assert pointer.generations() == ["gen-000000", "gen-000001"]
+        assert pointer.current() == ("gen-000001", gen1)
+
+    def test_publish_bundle_adopts_and_restamps(self, tmp_path):
+        src = _bundle(tmp_path / "src", 3)
+        manifest.write_manifest(src, step=7)  # stale source manifest
+        pointer = ModelPointer(str(tmp_path / "ptr"))
+        gen0 = pointer.publish_bundle(src, step=9)
+        ok, _ = manifest.verify(gen0)
+        assert ok
+        assert manifest.read_manifest(gen0)["extra"]["generation"] == "gen-000000"
+
+    def test_torn_publish_fails_cheap_verify(self, tmp_path):
+        pointer = ModelPointer(str(tmp_path / "ptr"))
+        plan = chaos.ChaosPlan(seed=0).site(
+            "serving.swap_torn", probability=1.0, max_count=1
+        )
+        chaos.install(plan, propagate=False)
+        gen0 = pointer.publish(_builder, _params(2))
+        assert plan.fired("serving.swap_torn") == 1
+        ok, reason = manifest.verify(gen0)
+        assert not ok and reason
+
+
+class TestReplicaServer:
+    def test_hot_swap_serves_new_generation(self, tmp_path):
+        pointer = ModelPointer(str(tmp_path / "ptr"))
+        pointer.publish(_builder, _params(2))
+        rep = ReplicaServer(pointer.root, poll_interval=999)
+        rep.start()
+        client = InferenceClient(
+            rep.address, timeout=30, retry=resilience.RetryPolicy(max_attempts=1)
+        )
+        try:
+            assert _value(client.predict_binary(x=np.ones((1, 1), np.float32))) == 2.0
+            swaps = _counter("serving_swaps_total")
+            pointer.publish(_builder, _params(5))
+            assert rep.check_swap() is True
+            assert _counter("serving_swaps_total") - swaps == 1
+            assert rep.generation() == "gen-000001"
+            assert _value(client.predict_binary(x=np.ones((1, 1), np.float32))) == 5.0
+            # same pointer again: no second swap, no second compile
+            assert rep.check_swap() is False
+            assert _counter("serving_swaps_total") - swaps == 1
+        finally:
+            client.close()
+            rep.stop()
+
+    def test_torn_swap_rejected_old_model_keeps_serving(self, tmp_path):
+        pointer = ModelPointer(str(tmp_path / "ptr"))
+        pointer.publish(_builder, _params(2))
+        rep = ReplicaServer(pointer.root, poll_interval=999)
+        rep.start()
+        client = InferenceClient(
+            rep.address, timeout=30, retry=resilience.RetryPolicy(max_attempts=1)
+        )
+        try:
+            rejects = _counter("serving_swap_rejects_total")
+            chaos.install(
+                chaos.ChaosPlan(seed=1).site(
+                    "serving.swap_torn", probability=1.0, max_count=1
+                ),
+                propagate=False,
+            )
+            pointer.publish(_builder, _params(9))  # torn on disk
+            assert rep.check_swap() is False
+            assert _counter("serving_swap_rejects_total") - rejects == 1
+            assert rep.generation() == "gen-000000"
+            assert _value(client.predict_binary(x=np.ones((1, 1), np.float32))) == 2.0
+            # the rejected generation is remembered: no re-verify, no recount
+            assert rep.check_swap() is False
+            assert _counter("serving_swap_rejects_total") - rejects == 1
+            chaos.uninstall()
+            pointer.publish(_builder, _params(7))  # a good publish recovers
+            assert rep.check_swap() is True
+            assert _value(client.predict_binary(x=np.ones((1, 1), np.float32))) == 7.0
+        finally:
+            client.close()
+            rep.stop()
+
+
+class _SlowEcho(serving.ProtocolServer):
+    """A protocol-speaking replica stand-in whose answers take ``delay``
+    seconds — the hedging target."""
+
+    def __init__(self, delay):
+        self.delay = delay
+        serving.ProtocolServer.__init__(self, host="127.0.0.1", port=0,
+                                        name="tos-test-slow")
+
+    def _submit(self, arrays):
+        time.sleep(self.delay)
+        return {"y_": np.full_like(np.asarray(arrays["x"]), 99.0)}
+
+
+class TestReplicaRouter:
+    @pytest.fixture
+    def pair(self, tmp_path):
+        a = InferenceServer(_bundle(tmp_path / "a", 1))
+        b = InferenceServer(_bundle(tmp_path / "b", 2))
+        a.start()
+        b.start()
+        yield a, b
+        a.stop()
+        b.stop()
+
+    def test_round_robin_spreads_requests(self, pair):
+        a, b = pair
+        router = _fast_router({0: a.address, 1: b.address})
+        try:
+            seen = {
+                _value(router.predict_binary(x=np.ones((1, 1), np.float32)))
+                for _ in range(4)
+            }
+            assert seen == {1.0, 2.0}
+        finally:
+            router.close()
+
+    def test_failover_reroutes_around_dead_replica(self, pair):
+        a, b = pair
+        a.kill()  # abrupt socket death; rid 0 is picked first every cycle
+        failovers = _counter("serving_failovers_total")
+        router = _fast_router({0: a.address, 1: b.address}, breaker_threshold=50)
+        try:
+            for _ in range(3):
+                out = router.predict_binary(x=np.ones((1, 1), np.float32))
+                assert _value(out) == 2.0
+            assert _counter("serving_failovers_total") - failovers >= 3
+        finally:
+            router.close()
+
+    def test_all_circuits_open_sheds_with_distinct_reason(self):
+        eps = {0: ("127.0.0.1", _dead_port()), 1: ("127.0.0.1", _dead_port())}
+        shed = _counter("serving_mesh_shed_total")
+        trips = _counter("serving_circuit_open_total")
+        router = _fast_router(eps, breaker_threshold=1, breaker_reset=60.0)
+        try:
+            with pytest.raises(Overloaded, match="circuits open"):
+                router.predict_binary(x=np.ones((1, 1), np.float32))
+            assert _counter("serving_mesh_shed_total") - shed == 1
+            assert _counter("serving_circuit_open_total") - trips == 2
+        finally:
+            router.close()
+
+    def test_empty_mesh_sheds_immediately(self):
+        shed = _counter("serving_mesh_shed_total")
+        router = _fast_router({})
+        try:
+            with pytest.raises(Overloaded, match="no live replicas"):
+                router.predict(x=[[1.0]])
+            assert _counter("serving_mesh_shed_total") - shed == 1
+        finally:
+            router.close()
+
+    def test_final_error_names_replicas_elapsed_and_budget(self):
+        eps = {0: ("127.0.0.1", _dead_port())}
+        router = _fast_router(eps, deadline=1.0, breaker_threshold=100)
+        try:
+            with pytest.raises(ConnectionError) as err:
+                router.predict_binary(x=np.ones((1, 1), np.float32))
+            msg = str(err.value)
+            assert "replica(s) [0]" in msg
+            assert "1s budget" in msg
+            assert "after" in msg
+        finally:
+            router.close()
+
+    def test_hedge_to_second_replica_wins(self, tmp_path):
+        slow = _SlowEcho(delay=1.5)
+        slow.start()
+        fast = InferenceServer(_bundle(tmp_path / "fast", 4))
+        fast.start()
+        hedges = _counter("serving_hedges_total")
+        router = _fast_router(
+            {0: slow.address, 1: fast.address}, hedge_after=0.15
+        )
+        try:
+            out = router.predict_binary(x=np.ones((1, 1), np.float32))
+            assert _value(out) == 4.0  # the hedge answered first
+            assert _counter("serving_hedges_total") - hedges == 1
+        finally:
+            router.close()
+            fast.stop()
+            slow.stop()
+
+
+class TestMeshLifecycle:
+    def test_start_route_and_frontend(self, tmp_path):
+        mesh = ServingMesh(
+            _bundle(tmp_path / "bundle", 3), replicas=2, mode="thread",
+            monitor_interval=0.5,
+        )
+        mesh.start()
+        router = mesh.router(deadline=10.0)
+        front = MeshFrontend(router, host="127.0.0.1")
+        front.start()
+        client = InferenceClient(front.address, timeout=30)
+        try:
+            assert len(mesh.endpoints()) == 2
+            assert _value(router.predict_binary(x=np.ones((1, 1), np.float32))) == 3.0
+            # the frontend speaks the plain InferenceServer protocol
+            out = client.predict_binary(x=np.ones((1, 1), np.float32))
+            assert _value(out) == 3.0
+            assert client.info().get("mesh") is True
+        finally:
+            client.close()
+            front.stop()
+            router.close()
+            mesh.stop()
+
+    def test_kill_expires_lease_relaunches_and_requests_survive(self, tmp_path):
+        """ISSUE 13 e2e (thread mode): hard-kill 1 of 2 replicas under load —
+        every request completes via failover, the dead lease expires, the
+        active gauge dips, and the slot relaunches on a fresh port."""
+        mesh = ServingMesh(
+            _bundle(tmp_path / "bundle", 3), replicas=2, mode="thread",
+            monitor_interval=0.2, lease_ttl=0.8,
+        )
+        mesh.start()
+        router = mesh.router(deadline=15.0)
+        relaunches = _counter("serving_replica_relaunches_total")
+        expiries = _counter("registry_lease_expirations_total")
+        errors = []
+        min_active = [99]
+        stop = threading.Event()
+
+        def load():
+            while not stop.is_set():
+                try:
+                    out = router.predict_binary(x=np.ones((1, 1), np.float32))
+                    assert _value(out) == 3.0
+                except Exception as e:  # any client-visible failure is a bug
+                    errors.append(e)
+                min_active[0] = min(min_active[0], _gauge("serving_replicas_active"))
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=load) for _ in range(3)]
+        try:
+            # wait for at least one renewed beat so the victim's lease is
+            # expirable (never-beat leases are expiry-exempt by contract)
+            deadline = time.time() + 10
+            while time.time() < deadline and mesh._beats.get(0, 0) < 1:
+                time.sleep(0.05)
+            assert mesh._beats.get(0, 0) >= 1
+            old_addr = mesh.endpoints()[0]
+            for t in threads:
+                t.start()
+            assert mesh.kill_replica(0) == 0
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if (
+                    _counter("serving_replica_relaunches_total") - relaunches >= 1
+                    and len(mesh.endpoints()) == 2
+                ):
+                    break
+                time.sleep(0.1)
+            time.sleep(0.3)  # a little settled load on the recovered mesh
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert not errors, errors[:3]
+            assert _counter("serving_replica_relaunches_total") - relaunches >= 1
+            assert _counter("registry_lease_expirations_total") - expiries >= 1
+            assert len(mesh.endpoints()) == 2
+            assert mesh.endpoints()[0] != old_addr  # fresh port after relaunch
+            assert min_active[0] <= 1  # the gauge dip was observable
+            assert _gauge("serving_replicas_active") == 2
+        finally:
+            stop.set()
+            router.close()
+            mesh.stop()
+
+    def test_hot_swap_under_load_zero_failures(self, tmp_path):
+        """ISSUE 13 e2e: publish a new generation mid-load — responses flip,
+        zero dropped/failed requests, exactly one swap (compile) per
+        replica, and no rejects."""
+        pointer = ModelPointer(str(tmp_path / "ptr"))
+        pointer.publish(_builder, _params(2))
+        mesh = ServingMesh(
+            pointer.root, replicas=2, mode="thread",
+            monitor_interval=0.5, swap_poll=0.1,
+        )
+        mesh.start()
+        router = mesh.router(deadline=15.0)
+        swaps = _counter("serving_swaps_total")
+        rejects = _counter("serving_swap_rejects_total")
+        values, errors = [], []
+        stop = threading.Event()
+
+        def load():
+            while not stop.is_set():
+                try:
+                    values.append(
+                        _value(router.predict_binary(x=np.ones((1, 1), np.float32)))
+                    )
+                except Exception as e:
+                    errors.append(e)
+                time.sleep(0.005)
+
+        threads = [threading.Thread(target=load) for _ in range(4)]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            pointer.publish(_builder, _params(6))
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                with mesh._lock:
+                    gens = [rec.server.generation() for rec in mesh._replicas.values()]
+                if all(g == "gen-000001" for g in gens):
+                    break
+                time.sleep(0.05)
+            time.sleep(0.3)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+            assert not errors, errors[:3]
+            assert set(values) <= {2.0, 6.0}
+            assert values[-1] == 6.0  # responses flipped to the new model
+            assert _counter("serving_swaps_total") - swaps == 2
+            assert _counter("serving_swap_rejects_total") - rejects == 0
+        finally:
+            stop.set()
+            router.close()
+            mesh.stop()
+
+    def test_cli_mesh_mode_scrape_shows_replica_gauge(self, tmp_path):
+        """Satellite: ``serving mesh --metrics_port`` publishes the mesh
+        gauges, so a scrape shows ``serving_replicas_active``."""
+        bundle = _bundle(tmp_path / "bundle", 3)
+        front_port, metrics_port = _dead_port(), _dead_port()
+        t = threading.Thread(
+            target=serving.main,
+            args=(
+                [
+                    "mesh", "--export_dir", bundle, "--replicas", "2",
+                    "--host", "127.0.0.1", "--port", str(front_port),
+                    "--metrics_port", str(metrics_port),
+                ],
+            ),
+            daemon=True,
+        )
+        t.start()
+        try:
+            body = None
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        "http://127.0.0.1:{}/metrics".format(metrics_port), timeout=5
+                    ) as resp:
+                        body = resp.read().decode("utf-8")
+                    break
+                except OSError:
+                    time.sleep(0.2)
+            assert body is not None, "metrics endpoint never came up"
+            assert "serving_replicas_active" in body
+            client = InferenceClient(("127.0.0.1", front_port), timeout=30)
+            try:
+                out = client.predict_binary(x=np.ones((1, 1), np.float32))
+                assert _value(out) == 3.0
+            finally:
+                client.close()
+        finally:
+            deadline = time.time() + 10
+            while serving._exit_event is None and time.time() < deadline:
+                time.sleep(0.05)
+            if serving._exit_event is not None:
+                serving._exit_event.set()
+            t.join(timeout=60)
+        assert not t.is_alive(), "mesh CLI did not shut down"
+
+
+class TestMeshProcessMode:
+    @pytest.mark.slow
+    def test_process_replicas_serve_and_survive_sigkill(self, tmp_path):
+        """Process-mode smoke: forked replicas serve; a SIGKILL'd child is
+        discovered, its lease expires, and the slot relaunches."""
+        mesh = ServingMesh(
+            _bundle(tmp_path / "bundle", 5), replicas=2, mode="process",
+            monitor_interval=0.3, lease_ttl=1.0,
+        )
+        mesh.start()
+        router = mesh.router(deadline=20.0)
+        relaunches = _counter("serving_replica_relaunches_total")
+        try:
+            assert _value(router.predict_binary(x=np.ones((1, 1), np.float32))) == 5.0
+            assert mesh.kill_replica(0) == 0
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if _counter("serving_replica_relaunches_total") - relaunches >= 1:
+                    break
+                out = router.predict_binary(x=np.ones((1, 1), np.float32))
+                assert _value(out) == 5.0
+                time.sleep(0.2)
+            assert _counter("serving_replica_relaunches_total") - relaunches >= 1
+            assert _value(router.predict_binary(x=np.ones((1, 1), np.float32))) == 5.0
+        finally:
+            router.close()
+            mesh.stop()
