@@ -26,6 +26,11 @@ Modes (BENCH_MODE env):
 * ``ckpt`` — training-thread stall per checkpoint save, blocking
   ``save_checkpoint`` vs the async engine's snapshot-only cost
   (``vs_baseline`` = the stall speedup; see docs/perf.md).
+* ``multichip`` — measured weak scaling of the multi-host plane: 1/2/4/8
+  single-device gloo ranks (``BENCH_RANKS``), host-side bucketed gradient
+  all-reduce with collective/compute overlap; reports scaling efficiency,
+  per-rank step-time p50/p99 spread, and the measured overlap fraction
+  (``value``). Rank timings outside the pair-validity band are discarded.
 * ``mnist_epoch`` — BASELINE.json metric 2, "MNIST epoch time
   (InputMode.SPARK)": wall-clock seconds to push one epoch of MNIST-shaped
   rows through a live 1-worker cluster's feed plane (reservation server,
@@ -1117,6 +1122,224 @@ def bench_ckpt(tiny):
     }
 
 
+def _multichip_member(pid, num_procs, coord_port, root_addr):
+    """One rank of the multichip weak-scaling world: joins the gloo world,
+    forms the host all-reduce group, and runs the bucketed-overlap step
+    windows — one overlap=False window, then two overlap=True windows (the
+    two-window pair is the validity probe: a rank whose two ON windows
+    disagree beyond the pair band was descheduled mid-measurement and its
+    timing is noise). Prints one ``MCRESULT {pid} {json}`` line."""
+    import sys
+
+    from tensorflowonspark_tpu.testing import join_cpu_world
+
+    join_cpu_world(pid, num_procs, coord_port, local_devices=1)
+    import statistics
+
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import parallel
+    from tensorflowonspark_tpu.parallel.hostreduce import HostAllReduceGroup
+    from tensorflowonspark_tpu.train import BucketedOverlap, SyncDataParallel
+
+    steps = int(os.environ.get("BENCH_MC_STEPS", "4"))
+    micro = int(os.environ.get("BENCH_MC_MICRO", "2"))
+    rows = int(os.environ.get("BENCH_MC_ROWS", "16"))
+    width = int(os.environ.get("BENCH_MC_WIDTH", "512"))
+
+    strategy = SyncDataParallel(parallel.local_mesh({"dp": -1}))
+
+    def init_fn(rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "w1": jax.random.normal(k1, (width, width)) * 0.05,
+            "w2": jax.random.normal(k2, (width, 64)) * 0.05,
+        }
+
+    def loss_fn(params, batch):
+        import jax.numpy as jnp
+
+        h = jnp.tanh(batch["x"] @ params["w1"])
+        for _ in range(4):
+            h = jnp.tanh(h @ params["w1"])
+        return jnp.mean((h @ params["w2"] - batch["y"]) ** 2)
+
+    opt = optax.adam(1e-3)
+    rng = np.random.default_rng(1000 + pid)  # weak scaling: per-rank data
+    mbs = [
+        strategy.shard_batch(
+            {
+                "x": rng.normal(size=(rows, width)).astype(np.float32),
+                "y": rng.normal(size=(rows, 64)).astype(np.float32),
+            }
+        )
+        for _ in range(micro)
+    ]
+
+    with HostAllReduceGroup(pid, num_procs, root_address=root_addr) as group:
+
+        def window(overlap, n):
+            state = strategy.create_state(init_fn, opt, jax.random.PRNGKey(0))
+            sched = BucketedOverlap(
+                strategy, loss_fn, opt, group=group,
+                bucket_bytes=1 << 19, overlap=overlap,
+            )
+            times, fractions, comm = [], [], []
+            last_loss = None
+            state, _ = sched.step(state, mbs)  # warmup: compile off-window
+            for _ in range(n):
+                t0 = time.perf_counter()
+                state, metrics = sched.step(state, mbs)
+                times.append(time.perf_counter() - t0)
+                fractions.append(sched.last_stats["overlap_fraction"])
+                comm.append(sched.last_stats["comm_busy_s"])
+                last_loss = float(metrics["loss"])
+            sched.close()
+            return times, fractions, comm, last_loss
+
+        t_off, _, _, loss_off = window(False, steps)
+        t_on1, f1, c1, loss_on = window(True, steps)
+        t_on2, f2, c2, _ = window(True, steps)
+
+    result = {
+        "pid": pid,
+        "off_step_s": t_off,
+        "on_step_s": t_on1 + t_on2,
+        "on_window_rates": [steps / sum(t_on1), steps / sum(t_on2)],
+        "overlap_fraction": statistics.mean(f1 + f2),
+        "comm_s_per_step": statistics.mean(c1 + c2),
+        "loss_on": loss_on,
+        "loss_off": loss_off,
+    }
+    print("MCRESULT {} {}".format(pid, json.dumps(result)), flush=True)
+    sys.stdout.flush()
+
+
+def _multichip_world(num_procs):
+    """Spawn one ``num_procs``-rank world and collect every rank's MCRESULT."""
+    import subprocess
+    import sys
+
+    from tensorflowonspark_tpu import util
+
+    coord_port = util.find_free_port()
+    root_addr = "127.0.0.1:{}".format(util.find_free_port())
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # one device per rank
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "multichip_member",
+             str(pid), str(num_procs), str(coord_port), root_addr],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        for pid in range(num_procs)
+    ]
+    results = {}
+    logs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=900)
+        logs.append(out)
+        for line in out.splitlines():
+            if line.startswith("MCRESULT "):
+                _, pid_s, payload = line.split(" ", 2)
+                results[int(pid_s)] = json.loads(payload)
+    if len(results) != num_procs:
+        raise RuntimeError(
+            "multichip world of {} lost ranks; logs:\n{}".format(
+                num_procs, "\n---\n".join(log[-2000:] for log in logs)
+            )
+        )
+    return [results[pid] for pid in range(num_procs)]
+
+
+def bench_multichip():
+    """``BENCH_MODE=multichip`` — measured weak scaling of the multi-host
+    performance plane: 1 -> 2 -> 4 -> 8 single-device gloo ranks on CPU
+    (``BENCH_RANKS`` overrides), fixed per-rank batch, host-side bucketed
+    gradient all-reduce with collective/compute overlap. Reports per-world
+    per-rank step-time p50/p99, weak-scaling efficiency t(1)/t(n) from the
+    cross-rank median, the measured comm/compute overlap fraction, and the
+    overlap-on vs overlap-off speedup. Rank timings whose two ON windows
+    disagree beyond the pair-validity band are discarded from the
+    efficiency median (a descheduled rank's window is host-scheduler mood,
+    not comm signal); ``confidence`` counts what survived. On hosts with
+    fewer cores than ranks the worlds timeshare and efficiency reads as
+    ~1/n — the spread and overlap numbers remain meaningful, the absolute
+    efficiency is the host's, not the plane's (docs/perf.md)."""
+    import statistics
+
+    ranks = [
+        int(r)
+        for r in os.environ.get("BENCH_RANKS", "1,2,4,8").split(",")
+        if r.strip()
+    ]
+    worlds = {}
+    medians = {}
+    fractions_all = []
+    for n in ranks:
+        members = _multichip_world(n)
+        losses = {round(m["loss_on"], 12) for m in members}
+        per_rank = {}
+        for m in members:
+            ms = sorted(1000.0 * t for t in m["on_step_s"])
+            per_rank[str(m["pid"])] = {
+                "p50": round(statistics.median(ms), 2),
+                "p99": round(ms[min(len(ms) - 1, int(0.99 * len(ms)))], 2),
+            }
+        w1 = [m["on_window_rates"][0] for m in members]
+        w2 = [m["on_window_rates"][1] for m in members]
+        valid, invalid = partition_pairs(w1, w2)
+        if not valid:
+            valid = [least_implausible_pair(w1, w2)]
+        # a valid pair's mean window rate -> that rank's step seconds
+        step_s = statistics.median(2.0 / (a + b) for a, b in valid)
+        medians[n] = step_s
+        frac = statistics.mean(m["overlap_fraction"] for m in members)
+        fractions_all.append(frac)
+        off_p50 = statistics.median(
+            t for m in members for t in m["off_step_s"]
+        )
+        worlds[str(n)] = {
+            "per_rank_step_ms": per_rank,
+            "step_ms_p50": round(1000.0 * step_s, 2),
+            "per_rank_spread": round(
+                max(r["p50"] for r in per_rank.values())
+                / max(1e-9, min(r["p50"] for r in per_rank.values())),
+                3,
+            ),
+            "overlap_fraction": round(frac, 3),
+            "overlap_speedup": round(off_p50 / step_s, 3),
+            "comm_s_per_step": round(
+                statistics.mean(m["comm_s_per_step"] for m in members), 5
+            ),
+            "loss_agrees_across_ranks": len(losses) == 1,
+            "loss_on_equals_off": all(
+                m["loss_on"] == m["loss_off"] for m in members
+            ),
+            "confidence": confidence_fields(
+                len(members), len(members), invalid_pairs=len(invalid)
+            ),
+        }
+    base = medians[ranks[0]]
+    return {
+        "bench": "multichip",
+        "mode": "weak_scaling",
+        "value": round(fractions_all and statistics.mean(fractions_all) or 0.0, 3),
+        "metric": "comm_overlap_fraction",
+        "rank_counts": ranks,
+        "scaling_efficiency": {
+            str(n): round(base / medians[n], 3) for n in ranks
+        },
+        "overlap_fraction": round(statistics.mean(fractions_all), 3),
+        "worlds": worlds,
+        "host_cores": os.cpu_count() or 1,
+        "timesharing_caveat": (os.cpu_count() or 1) < max(ranks),
+    }
+
+
 def bench_decode(tiny):
     """Input-path-only throughput across the decode stack's rungs on
     identical ImageNet-schema shards: the PIL thread pool (the pre-native
@@ -1278,10 +1501,19 @@ def main():
         result = bench_lm(tiny)
     elif mode == "serving":
         result = bench_serving(tiny)
+    elif mode == "multichip":
+        result = bench_multichip()
     else:
         result = bench_resnet(tiny, real_data=(mode != "resnet"))
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "multichip_member":
+        _multichip_member(
+            int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]), sys.argv[5]
+        )
+    else:
+        main()

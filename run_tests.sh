@@ -8,10 +8,14 @@
 # integration tests can target real Spark executors.
 #
 # Usage: ./run_tests.sh [--quick] [--chaos] [--perf-smoke] [--analyze]
-#                       [--native-sanitize] [extra pytest args]
+#                       [--native-sanitize] [--multichip] [extra pytest args]
 #   --quick       run the quick tier only (pytest -m 'not slow')
 #   --chaos       run the quick tier under a fixed low-probability ChaosPlan and
 #                 assert that at least one fault was actually injected
+#   --multichip   run only the multi-process gloo legs: 2-rank host all-reduce
+#                 determinism + bucketed-overlap smoke (always), and the 4-rank
+#                 weak-scaling smoke (skips cleanly on hosts under 4 cores
+#                 where four lockstep jax processes just timeshare one core)
 #   --perf-smoke  run only the perf_smoke marker leg: structural pipelining
 #                 assertions (sleep-staged IO/parse overlap — proves the
 #                 read-ahead actually overlaps, no absolute-throughput flake)
@@ -37,6 +41,7 @@ cd "$(dirname "$0")"
 CHAOS=0
 PERF_SMOKE=0
 NATIVE_SANITIZE=0
+MULTICHIP=0
 EXTRA=()
 for arg in "$@"; do
   if [[ "$arg" == "--quick" ]]; then
@@ -50,6 +55,8 @@ for arg in "$@"; do
     exec python -m tosa --json --out tosa-report.json --sarif-out tosa-report.sarif
   elif [[ "$arg" == "--native-sanitize" ]]; then
     NATIVE_SANITIZE=1
+  elif [[ "$arg" == "--multichip" ]]; then
+    MULTICHIP=1
   else
     EXTRA+=("$arg")
   fi
@@ -97,6 +104,15 @@ else
   echo "pyspark not installed: using the bundled local multi-process backend"
 fi
 
+if [[ "$MULTICHIP" == "1" ]]; then
+  # multi-process gloo legs (tests/test_multichip.py): 2-rank host
+  # all-reduce determinism + bucketed-overlap bit-identity smoke runs
+  # everywhere; the 4-rank weak-scaling smoke marks itself skipped below
+  # 4 cores (four lockstep jax worlds on one core prove nothing)
+  exec python -m pytest tests/test_multichip.py -q -m "not chaos" \
+    ${EXTRA[@]+"${EXTRA[@]}"}
+fi
+
 if [[ "$PERF_SMOKE" == "1" ]]; then
   # covers the IO/parse overlap proof, the autotune adaptation leg
   # (tests/test_autotune.py::TestChaosDeviceLink) — both sleep-staged, no
@@ -129,6 +145,12 @@ if [[ "$CHAOS" == "1" ]]; then
   # replicas_active gauge dips and recovers, and the dead lease expires.
   echo "chaos leg: serving.replica_kill mesh-failover run"
   python -m pytest tests/test_chaos_mesh.py -q -m "chaos and slow"
+  # comm-plane leg (self-installed plan): comm.link_delay makes one rank's
+  # host all-reduces straggle — the 2-rank world must degrade gracefully
+  # (bit-identical losses, steps complete) and the straggler must be
+  # visible in the per-rank step-time spread bucketed overlap reports.
+  echo "chaos leg: comm.link_delay straggler run"
+  python -m pytest tests/test_multichip.py -q -m "chaos and slow"
   # Benign-in-outcome sites at low probability: the suite's assertions
   # must keep passing — most sites only perturb timing; data.decode_kill
   # SIGKILLs a decode worker, which the plane's respawn-and-release
@@ -145,6 +167,7 @@ if [[ "$CHAOS" == "1" ]]; then
     "serving.latency":      {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "reservation.slow_accept": {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "control.lease_delay":  {"probability": 0.05, "max_count": null, "delay_s": 0.005},
+    "comm.link_delay":      {"probability": 0.05, "max_count": null, "delay_s": 0.005, "victim": 0},
     "ckpt.snapshot_stall":  {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "ckpt.write_slow":      {"probability": 0.05, "max_count": null, "delay_s": 0.01}
   }}'

@@ -26,17 +26,21 @@ Canonical mesh axes (any subset may be present, always in this order):
 # decided by the jax child process, not by whoever imported the package first.
 _EXPORTS = {
     "AXIS_ORDER": "mesh",
+    "build_hybrid_mesh": "mesh",
     "build_mesh": "mesh",
     "local_mesh": "mesh",
     "mesh_shape": "mesh",
+    "shard_map": "collectives",
     "batch_sharding": "sharding",
     "batch_spec": "sharding",
     "data_axes": "sharding",
     "fsdp_param_specs": "sharding",
+    "overlay_fsdp_specs": "sharding",
     "replicated": "sharding",
     "shard_batch": "sharding",
     "shard_params": "sharding",
     "collectives": None,
+    "HostAllReduceGroup": "hostreduce",
     "ring_attention": "ring_attention",
     "ring_attention_sharded": "ring_attention",
     "pipeline_apply": "pipeline_parallel",

@@ -43,15 +43,18 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh, axis="pp", batc
     concurrently — each dp column owns its slice end to end, the ppermute
     stage hops stay within the column, and params are replicated over dp.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel.collectives import shard_map
 
     n_stages = mesh_shape(mesh)[axis]
     del n_stages  # validated implicitly by the leading-dim split below
 
     def _worker(params, mb):
         params = jax.tree.map(lambda a: jnp.squeeze(a, 0), params)  # my stage
-        n_pp = lax.axis_size(axis)
+        from tensorflowonspark_tpu.parallel.collectives import axis_size
+
+        n_pp = axis_size(axis)
         idx = lax.axis_index(axis)
         n_micro = mb.shape[0]
 

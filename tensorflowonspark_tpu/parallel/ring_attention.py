@@ -49,7 +49,9 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     causal masking uses each block's origin index, so the result is exactly
     standard causal attention on the concatenated sequence.
     """
-    n = lax.axis_size(axis_name)
+    from tensorflowonspark_tpu.parallel.collectives import axis_size
+
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     _, _, l_q, head_dim = q.shape
     l_k = k.shape[2]
@@ -106,7 +108,9 @@ def ring_attention_sharded(q, k, v, mesh, causal=False, scale=None, axis="sp"):
         return plain_attention(q, k, v, causal=causal, scale=scale)
     bspec = batch if len(batch) > 1 else (batch[0] if batch else None)
     spec = P(bspec, None, axis, None)
-    fn = jax.shard_map(
+    from tensorflowonspark_tpu.parallel.collectives import shard_map
+
+    fn = shard_map(
         functools.partial(ring_attention, axis_name=axis, causal=causal, scale=scale),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -77,6 +77,60 @@ def fsdp_param_specs(params, mesh, min_weight_size=2**14):
     return jax.tree.map(spec_for, params)
 
 
+def _spec_axes(spec):
+    """Flat set of mesh-axis names a PartitionSpec already uses."""
+    used = set()
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return used
+
+
+def overlay_fsdp_specs(params, specs, mesh, min_weight_size=2**14):
+    """Overlay ZeRO-3 sharding onto an existing per-array spec tree.
+
+    The composition rule for hybrid dp×fsdp(×tp) meshes: a model's own
+    placement (e.g. :func:`tensorflowonspark_tpu.models.transformer.param_specs`
+    claiming ``tp``/``fsdp`` dims) wins where it already touches the ``fsdp``
+    axis; every other array big enough to be worth sharding gets its largest
+    still-unclaimed dim sharded along ``fsdp``, so the optimizer state and
+    per-step all-gather shrink even for arrays the model rules replicate.
+    With no ``fsdp`` axis in the mesh this is the identity.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if "fsdp" not in mesh.axis_names:
+        return specs
+    axis_size = mesh_axis_size(mesh, "fsdp")
+
+    def overlay(x, s):
+        import math
+
+        if "fsdp" in _spec_axes(s):
+            return s
+        shape = getattr(x, "shape", ())
+        if math.prod(shape) < min_weight_size:
+            return s
+        entries = list(tuple(s)) + [None] * (len(shape) - len(tuple(s)))
+        best, best_dim = None, -1
+        for i, d in enumerate(shape):
+            if entries[i] is None and d % axis_size == 0 and d > best_dim:
+                best, best_dim = i, d
+        if best is None:
+            return s
+        entries[best] = "fsdp"
+        return P(*entries)
+
+    return jax.tree.map(
+        overlay, params, specs, is_leaf=lambda n: isinstance(n, P)
+    )
+
+
 def mesh_axis_size(mesh, name):
     return dict(zip(mesh.axis_names, mesh.devices.shape)).get(name, 1)
 

@@ -11,6 +11,7 @@ over ICI, and checkpoint/resume is orbax.
 # checkpoint function is actually touched.
 _EXPORTS = {
     "SyncDataParallel": "strategy",
+    "BucketedOverlap": "strategy",
     "PackedLoopCache": "strategy",
     "TrainState": "strategy",
     "steps_per_worker": "strategy",

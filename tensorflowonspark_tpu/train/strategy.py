@@ -13,6 +13,8 @@ hand and no PS; sync DP over ICI serves both of the reference's modes
 """
 
 import logging
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -21,6 +23,7 @@ from tensorflowonspark_tpu.parallel import (
     batch_sharding,
     build_mesh,
     fsdp_param_specs,
+    overlay_fsdp_specs,
     replicated,
     shard_batch,
 )
@@ -105,19 +108,48 @@ class SyncDataParallel:
     # -- placement ------------------------------------------------------------
 
     def param_shardings(self, params_shape):
-        """NamedShardings for a params pytree (from shapes or real arrays)."""
-        from jax.sharding import NamedSharding
+        """NamedShardings for a params pytree (from shapes or real arrays).
+
+        ``param_spec_fn`` and ``fsdp`` compose: the model's own placement
+        rules run first, then the generic ZeRO-3 overlay shards any array the
+        model left untouched along ``fsdp`` (params are then reduce-scattered
+        / all-gathered per step by XLA from the shardings alone). The
+        ``fsdp_params_sharded`` gauge reports how many param arrays actually
+        ended up sharded, so a mis-sized ``min_weight_size`` (everything
+        replicated) is visible in ``TFCluster.metrics()``.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec
 
         if self.param_spec_fn is not None:
             specs = self.param_spec_fn(params_shape, self.mesh)
-            return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs)
-        if self.fsdp:
+            if self.fsdp:
+                specs = overlay_fsdp_specs(
+                    params_shape, specs, self.mesh,
+                    min_weight_size=self.min_weight_size,
+                )
+        elif self.fsdp:
             specs = fsdp_param_specs(
                 params_shape, self.mesh, min_weight_size=self.min_weight_size
             )
-            return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs)
-        rep = replicated(self.mesh)
-        return jax.tree.map(lambda _: rep, params_shape)
+        else:
+            rep = PartitionSpec()
+            specs = jax.tree.map(lambda _: rep, params_shape)
+        if self.fsdp:
+            from tensorflowonspark_tpu import obs
+            from tensorflowonspark_tpu.parallel.sharding import _spec_axes
+
+            n_sharded = sum(
+                1
+                for s in jax.tree.leaves(
+                    specs, is_leaf=lambda n: isinstance(n, PartitionSpec)
+                )
+                if isinstance(s, PartitionSpec) and "fsdp" in _spec_axes(s)
+            )
+            obs.gauge(
+                "fsdp_params_sharded",
+                help="param arrays sharded along the fsdp axis (ZeRO-3)",
+            ).set(n_sharded)
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs)
 
     def shard_batch(self, batch):
         return shard_batch(batch, self.mesh)
@@ -225,7 +257,7 @@ class SyncDataParallel:
         except (TypeError, ValueError):
             wants_step = False
 
-        def step(state, batch):
+        def train_step(state, batch):
             kw = {"step": state.step} if wants_step else {}
             if mutable:
                 (loss, (model_state, aux)), grads = jax.value_and_grad(
@@ -243,7 +275,7 @@ class SyncDataParallel:
                 metrics.update(aux)
             return new_state, metrics
 
-        return jax.jit(step, donate_argnums=(0,) if donate else ())
+        return jax.jit(train_step, donate_argnums=(0,) if donate else ())
 
     def compile_train_loop(self, loss_fn, optimizer, num_steps, has_aux=False, mutable=False, donate=True, packed=False):
         """Compile ``loop(state, batches) -> (state, last_metrics)`` running
@@ -335,6 +367,279 @@ class SyncDataParallel:
         """Compile ``apply_fn(params, batch) -> predictions``; outputs gather
         to fully-addressable arrays for host-side result queues."""
         return jax.jit(apply_fn, out_shardings=replicated(self.mesh))
+
+
+class BucketedOverlap:
+    """Bucketed gradient sync overlapping collectives with backprop.
+
+    The serial path (:meth:`SyncDataParallel.compile_train_step`) lets XLA
+    insert the gradient all-reduce inside the step program, which the CPU
+    PJRT client executes strictly in order — a straggler peer stalls the
+    whole stream (measured; see :mod:`tensorflowonspark_tpu.parallel.hostreduce`).
+    This scheduler splits the step into microbatches and moves gradient
+    synchronization onto a dedicated comm thread: as each microbatch's
+    backprop program is dispatched, the comm thread fetches its gradients
+    (waiting on the device stream *beside* the next microbatch's compute),
+    partitions them into byte-bounded buckets, and runs one deterministic
+    host all-reduce per bucket through a
+    :class:`~tensorflowonspark_tpu.parallel.hostreduce.HostAllReduceGroup`.
+    The optimizer applies the accumulated mean once per step.
+
+    ``overlap=False`` runs the *identical* dispatch sequence but joins the
+    comm thread after every microbatch — the same programs, fetches, sums
+    and reductions in the same order, differing only in host-side fencing,
+    so loss trajectories are bit-identical with overlap on or off (the
+    packed-window double-buffer fencing discipline, applied to grads).
+
+    Compiled-program budget mirrors :class:`PackedLoopCache`: one grad
+    program per microbatch shape and one apply program total, cached
+    forever; the per-bucket work is host numpy and never recompiles.
+
+    Donation contract: the grad program donates **nothing** — its outputs
+    are referenced by the comm thread until each bucket is fetched, and its
+    ``params`` input is shared by every microbatch. Only the apply program
+    donates (params, opt_state), which no in-flight collective can
+    reference because :meth:`step` drains the comm thread first.
+
+    Scope: replicated-params data parallelism (each process steps its own
+    replica, like the reference's ``MultiWorkerMirroredStrategy``); FSDP
+    params sync through XLA's sharding-derived collectives instead —
+    constructor rejects an FSDP strategy.
+
+    Per-step stats land in :attr:`last_stats` and the
+    ``comm_overlap_fraction`` gauge::
+
+        group = HostAllReduceGroup(rank, world)
+        sched = BucketedOverlap(strategy, loss_fn, optimizer, group=group)
+        state, metrics = sched.step(state, microbatches)
+    """
+
+    def __init__(self, strategy, loss_fn, optimizer, group=None,
+                 bucket_bytes=1 << 22, overlap=True, has_aux=False):
+        import queue
+
+        if getattr(strategy, "fsdp", False):
+            raise ValueError(
+                "BucketedOverlap needs replicated params; FSDP-sharded "
+                "params already sync through XLA's sharding-derived "
+                "reduce-scatter/all-gather"
+            )
+        self.strategy = strategy
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.group = group
+        self.bucket_bytes = int(bucket_bytes)
+        self.overlap = overlap
+        self.has_aux = has_aux
+        self.last_stats = {}
+        self._grad_fns = {}
+        self._apply_fn = None
+        self._buckets = None  # list of (dtype, [leaf indices]) once shapes known
+        self._treedef = None
+        self._jobs = queue.Queue()
+        self._worker = None
+        self._worker_err = None
+
+    # -- compiled programs -----------------------------------------------------
+
+    def _grad_fn(self, batch):
+        key = tuple(
+            (getattr(x, "shape", ()), str(getattr(x, "dtype", "")))
+            for x in jax.tree.leaves(batch)
+        )
+        fn = self._grad_fns.get(key)
+        if fn is None:
+            # donate nothing: params feed every microbatch, grads are read by
+            # the comm thread after dispatch (donation-safety rule fixture:
+            # tests/test_tosa_dataflow.py::TestDonationSafety)
+            fn = jax.jit(
+                jax.value_and_grad(self.loss_fn, has_aux=self.has_aux),
+                donate_argnums=(),
+            )
+            self._grad_fns[key] = fn
+        return fn
+
+    def _apply(self):
+        if self._apply_fn is None:
+            import optax
+
+            def apply(params, opt_state, step, grads, scale):
+                grads = jax.tree.map(lambda g: g * scale, grads)
+                updates, opt_state = self.optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, step + 1
+
+            self._apply_fn = jax.jit(apply, donate_argnums=(0, 1))
+        return self._apply_fn
+
+    # -- bucket partition ------------------------------------------------------
+
+    def _partition(self, grad_leaves):
+        """Partition flat grad-leaf indices into byte-bounded buckets, one
+        dtype per bucket (payloads concatenate raw)."""
+        buckets = []
+        cur, cur_bytes, cur_dtype = [], 0, None
+        order = sorted(
+            range(len(grad_leaves)), key=lambda i: str(grad_leaves[i].dtype)
+        )
+        for i in order:
+            leaf = grad_leaves[i]
+            dt = str(leaf.dtype)
+            if cur and (dt != cur_dtype or cur_bytes + leaf.nbytes > self.bucket_bytes):
+                buckets.append((cur_dtype, cur))
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += leaf.nbytes
+            cur_dtype = dt
+        if cur:
+            buckets.append((cur_dtype, cur))
+        return buckets
+
+    # -- comm thread -----------------------------------------------------------
+
+    def _comm_loop(self):
+        import numpy as np
+
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            grad_leaves, acc, done, stats, record = job
+            try:
+                for _dtype, idxs in self._buckets:
+                    leaves = [grad_leaves[i] for i in idxs]
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(leaves)  # device stream, not comm
+                    t1 = time.perf_counter()
+                    stats["device_wait_s"] += t1 - t0
+                    record["dw_end"] = t1
+                    flat = np.concatenate([np.asarray(x).ravel() for x in leaves])
+                    if self.group is not None:
+                        flat = self.group.allreduce_mean(flat)
+                    off = 0
+                    for i in idxs:
+                        n = int(np.prod(grad_leaves[i].shape, dtype=np.int64))
+                        part = flat[off:off + n].reshape(grad_leaves[i].shape)
+                        acc[i] = part if acc[i] is None else acc[i] + part
+                        off += n
+                    t2 = time.perf_counter()
+                    record["comm_spans"].append((t1, t2))
+                    stats["comm_busy_s"] += t2 - t1
+            except BaseException as e:  # surfaces at the next drain
+                self._worker_err = e
+            finally:
+                done.set()
+
+    def _ensure_worker(self):
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._comm_loop, name="grad-comm", daemon=True
+            )
+            self._worker.start()
+
+    def _check_err(self):
+        if self._worker_err is not None:
+            err, self._worker_err = self._worker_err, None
+            raise RuntimeError("gradient comm thread failed") from err
+
+    # -- the step --------------------------------------------------------------
+
+    def step(self, state, microbatches):
+        """One optimizer step over ``microbatches`` (a non-empty list of
+        batch pytrees, each already device-resident via
+        ``strategy.shard_batch``). Returns ``(state, metrics)`` with the
+        loss averaged over microbatches and ranks."""
+        import numpy as np
+
+        if not microbatches:
+            raise ValueError("step needs at least one microbatch")
+        self._ensure_worker()
+        self._check_err()
+        stats = {"comm_busy_s": 0.0, "device_wait_s": 0.0, "blocked_s": 0.0}
+        losses, dones, records = [], [], []
+        acc = None
+        t_step0 = time.perf_counter()
+        for batch in microbatches:
+            dispatch_ts = time.perf_counter()
+            out = self._grad_fn(batch)(state.params, batch)
+            (loss, _aux), grads = out if self.has_aux else ((out[0], None), out[1])
+            grad_leaves, treedef = jax.tree.flatten(grads)
+            if self._buckets is None:
+                self._buckets = self._partition(grad_leaves)
+                self._treedef = treedef
+                logger.info(
+                    "bucketed overlap: %d grad arrays -> %d bucket(s) <= %d bytes",
+                    len(grad_leaves), len(self._buckets), self.bucket_bytes,
+                )
+            if acc is None:
+                acc = [None] * len(grad_leaves)
+            losses.append(loss)
+            done = threading.Event()
+            dones.append(done)
+            record = {"dispatch_ts": dispatch_ts, "comm_spans": [], "dw_end": 0.0}
+            records.append(record)
+            self._jobs.put((grad_leaves, acc, done, stats, record))
+            if not self.overlap:
+                t0 = time.perf_counter()
+                done.wait()
+                stats["blocked_s"] += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for done in dones:
+            done.wait()
+        stats["blocked_s"] += time.perf_counter() - t0
+        self._check_err()
+
+        grads = jax.tree.unflatten(self._treedef, acc)
+        scale = jnp.asarray(1.0 / len(microbatches), dtype=jnp.float32)
+        params, opt_state, step = self._apply()(
+            state.params, state.opt_state, state.step, grads, scale
+        )
+        new_state = TrainState(step, params, opt_state, state.model_state)
+        loss = jnp.mean(jnp.stack(losses))
+        if self.group is not None and self.group.world > 1:
+            loss_mean = self.group.allreduce_mean(
+                np.asarray(loss, dtype=np.float32).reshape(1)
+            )[0]
+        else:
+            loss_mean = loss
+        stats["step_s"] = time.perf_counter() - t_step0
+        # measured overlap: comm seconds that ran while backprop work from a
+        # later microbatch was resident on the device stream. Job i's comm is
+        # hidden where its spans fall inside [dispatch of job i+1, last
+        # grad-ready time]; before that window nothing later is enqueued,
+        # after it the device is idle. overlap=False joins the comm thread
+        # before dispatching the next microbatch, so the window is empty and
+        # the fraction is exactly 0 — same programs, same order, only fencing.
+        window_end = max((r["dw_end"] for r in records), default=0.0)
+        hidden = 0.0
+        for i, rec in enumerate(records):
+            if i + 1 >= len(records):
+                break  # last job's comm has nothing behind it to hide under
+            window_start = records[i + 1]["dispatch_ts"]
+            for s, e in rec["comm_spans"]:
+                hidden += max(0.0, min(e, window_end) - max(s, window_start))
+        stats["hidden_comm_s"] = hidden
+        stats["overlap_fraction"] = (
+            min(1.0, hidden / stats["comm_busy_s"])
+            if stats["comm_busy_s"] > 0
+            else 0.0
+        )
+        self.last_stats = stats
+        from tensorflowonspark_tpu import obs
+
+        obs.gauge(
+            "comm_overlap_fraction",
+            help="fraction of host all-reduce time hidden behind device backprop",
+        ).set(stats["overlap_fraction"])
+        metrics = {"loss": loss_mean, "step": new_state.step}
+        return new_state, metrics
+
+    def close(self):
+        """Stop the comm thread (the group is the caller's to close)."""
+        if self._worker is not None and self._worker.is_alive():
+            self._jobs.put(None)
+            self._worker.join(timeout=10)
+        self._worker = None
 
 
 class PackedLoopCache:

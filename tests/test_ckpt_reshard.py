@@ -123,6 +123,131 @@ class TestReshardTrainState:
         )
 
 
+class TestHybridRoundTrip:
+    """Mesh-shape round trips: dp -> dp x fsdp -> dp, parameter-exact, plus
+    the elastic-ladder shrink landing on a smaller hybrid mesh. The proof
+    that a checkpoint is a mesh-independent set of bytes."""
+
+    def _make_state(self, strategy):
+        import jax
+        import optax
+
+        from tensorflowonspark_tpu.models import mnist
+
+        model = mnist.create_model("mlp", hidden=8)
+        optimizer = optax.sgd(0.1)
+        state = strategy.create_state(
+            mnist.make_init_fn(model), optimizer, jax.random.PRNGKey(0)
+        )
+        step = strategy.compile_train_step(
+            mnist.make_loss_fn(model), optimizer, has_aux=True, donate=False
+        )
+        rng = np.random.default_rng(7)
+        batch = strategy.shard_batch(
+            {
+                "image": rng.standard_normal((8, 28, 28)).astype(np.float32),
+                "label": rng.integers(0, 10, 8),
+            }
+        )
+        state, _ = step(state, batch)
+        return state
+
+    def _save(self, state, root, step_no):
+        with ckpt.AsyncCheckpointEngine(str(root)) as eng:
+            eng.save(state, step_no)
+            assert eng.drain(timeout=120)
+        path = os.path.join(str(root), "ckpt_{}".format(step_no))
+        assert ckpt.verify(path) == (True, "verified")
+        return path
+
+    def _assert_bitwise(self, host_state, restored):
+        import jax
+
+        for saved, back in zip(
+            jax.tree.leaves(host_state.params),
+            jax.tree.leaves(jax.device_get(restored.params)),
+        ):
+            np.testing.assert_array_equal(saved, back)
+        for saved, back in zip(
+            jax.tree.leaves(host_state.opt_state),
+            jax.tree.leaves(jax.device_get(restored.opt_state)),
+        ):
+            np.testing.assert_array_equal(saved, back)
+
+    def test_round_trip_dp_to_hybrid_and_back(self, tmp_path):
+        import jax
+        import optax
+
+        from tensorflowonspark_tpu import parallel
+        from tensorflowonspark_tpu.models import mnist
+        from tensorflowonspark_tpu.train import SyncDataParallel
+
+        devices = jax.local_devices()
+        dp4 = SyncDataParallel(parallel.build_mesh({"dp": 4}, devices=devices[:4]))
+        state = self._make_state(dp4)
+        host = jax.device_get(state)
+        path = self._save(state, tmp_path / "a", 1)
+
+        # leg 1: land the dp-mesh checkpoint on a 2x2 hybrid, params sharded
+        hybrid = SyncDataParallel(
+            parallel.build_mesh({"dp": 2, "fsdp": 2}, devices=devices[:4]),
+            fsdp=True, min_weight_size=1,
+        )
+        model = mnist.create_model("mlp", hidden=8)
+        fresh = hybrid.create_state(
+            mnist.make_init_fn(model), optax.sgd(0.1), jax.random.PRNGKey(9)
+        )
+        on_hybrid = reshard_restore(path, strategy=hybrid, target=fresh)
+        self._assert_bitwise(host, on_hybrid)
+        specs = _specs(on_hybrid.params)
+        assert any("fsdp" in (ax or ()) for spec in specs for ax in spec), specs
+
+        # leg 2: save FROM the hybrid placement, land back on the dp mesh —
+        # the bytes never change, only the placement does
+        path2 = self._save(on_hybrid, tmp_path / "b", 2)
+        fresh2 = dp4.create_state(
+            mnist.make_init_fn(model), optax.sgd(0.1), jax.random.PRNGKey(11)
+        )
+        back_on_dp = reshard_restore(path2, strategy=dp4, target=fresh2)
+        self._assert_bitwise(host, back_on_dp)
+        for spec in _specs(back_on_dp.params):
+            assert all("fsdp" not in (ax or ()) for ax in spec), spec
+
+    def test_elastic_shrink_onto_smaller_hybrid_mesh(self, tmp_path):
+        import jax
+        import optax
+
+        from tensorflowonspark_tpu import parallel
+        from tensorflowonspark_tpu.models import mnist
+        from tensorflowonspark_tpu.train import SyncDataParallel
+
+        devices = jax.local_devices()
+        # the full world: 2-way dp x 4-way fsdp over all 8 devices
+        full = SyncDataParallel(
+            parallel.build_mesh({"dp": 2, "fsdp": 4}, devices=devices),
+            fsdp=True, min_weight_size=1,
+        )
+        state = self._make_state(full)
+        host = jax.device_get(state)
+        path = self._save(state, tmp_path, 3)
+
+        # the shrink-to-fit world after losing half the hosts: 2x2 over the
+        # surviving 4 devices (the recovery ladder's resharded resume)
+        shrunk = SyncDataParallel(
+            parallel.build_mesh({"dp": 2, "fsdp": 2}, devices=devices[:4]),
+            fsdp=True, min_weight_size=1,
+        )
+        model = mnist.create_model("mlp", hidden=8)
+        fresh = shrunk.create_state(
+            mnist.make_init_fn(model), optax.sgd(0.1), jax.random.PRNGKey(5)
+        )
+        restored = reshard_restore(path, strategy=shrunk, target=fresh)
+        self._assert_bitwise(host, restored)
+        k = restored.params["Dense_0"]["kernel"]
+        assert k.sharding.mesh.shape == {"dp": 2, "fsdp": 2}
+        assert len(k.sharding.device_set) <= 4
+
+
 class TestReshardBarePytree:
     @pytest.fixture
     def saved_dict(self, tmp_path):

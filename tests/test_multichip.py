@@ -1,0 +1,446 @@
+"""Multi-host performance plane: hybrid mesh placement, host-side bucketed
+gradient overlap, and multi-process gloo worlds.
+
+Three layers, cheapest first:
+
+* pure placement math — ``_hybrid_factors`` / ``_hybrid_device_grid`` /
+  ``build_hybrid_mesh`` driven with fake slice-tagged device objects (the
+  ``TestMultiSliceWarning`` idiom), asserting DCN-outer/ICI-inner layout;
+* single-process ``BucketedOverlap`` — the overlap-on/off bit-identity
+  contract and the measured ``comm_overlap_fraction``;
+* real 2- and 4-rank gloo worlds (``util.spawn_process`` +
+  ``testing.join_cpu_world``, the test_jax_distributed pattern) proving the
+  :class:`HostAllReduceGroup` determinism contract cross-process, and the
+  ``comm.link_delay`` chaos straggler leg (graceful degradation, victim
+  gating, straggle visible in every rank's step-time distribution — sync
+  training is lockstep, so one slow link slows the world).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import util
+from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+
+class _FakeDev:
+    """Stands in for a TPU device: identity + slice tag, nothing else."""
+
+    def __init__(self, i, slice_index=None):
+        self.id = i
+        self.platform = "cpu"
+        if slice_index is not None:
+            self.slice_index = slice_index
+
+    def __repr__(self):
+        return "F{}s{}".format(self.id, getattr(self, "slice_index", "-"))
+
+
+def _two_slices(per_slice=4):
+    return [_FakeDev(i, i // per_slice) for i in range(2 * per_slice)]
+
+
+class TestHybridFactors:
+    def test_sequence_gives_whole_factor_to_first_fit(self):
+        f = mesh_mod._hybrid_factors({"dp": 4, "fsdp": 2}, 2, ("dp",))
+        assert f == {"dp": 2, "fsdp": 1}
+
+    def test_sequence_skips_non_dividing_axis(self):
+        f = mesh_mod._hybrid_factors({"dp": 3, "fsdp": 4}, 2, ("dp", "fsdp"))
+        assert f == {"dp": 1, "fsdp": 2}
+
+    def test_no_axis_can_absorb_raises(self):
+        with pytest.raises(ValueError, match="absorb the DCN dimension"):
+            mesh_mod._hybrid_factors({"tp": 3}, 2, ("dp",))
+
+    def test_dict_split_validated(self):
+        f = mesh_mod._hybrid_factors({"dp": 4, "fsdp": 4}, 4, {"dp": 2, "fsdp": 2})
+        assert f == {"dp": 2, "fsdp": 2}
+        with pytest.raises(ValueError, match="does not divide"):
+            mesh_mod._hybrid_factors({"dp": 3}, 2, {"dp": 2})
+        with pytest.raises(ValueError, match="multiply to the slice count"):
+            mesh_mod._hybrid_factors({"dp": 4, "fsdp": 4}, 4, {"dp": 2})
+
+
+class TestHybridDeviceGrid:
+    def test_slice_major_within_split_axis(self):
+        # dp=4 split 2 (DCN) x 2 (ICI): dp rows 0,1 from slice 0, rows 2,3
+        # from slice 1 — walking dp crosses the DCN boundary exactly once
+        devs = _two_slices(4)
+        grid = mesh_mod._hybrid_device_grid(
+            {"dp": 4, "fsdp": 2}, {"dp": 2, "fsdp": 1},
+            mesh_mod._slice_groups(devs),
+        )
+        assert grid.shape == (4, 2)
+        for j in range(4):
+            rows = {d.slice_index for d in grid[j]}
+            assert rows == {j // 2}, grid
+
+    def test_unsplit_axis_stays_inside_a_slice(self):
+        devs = _two_slices(4)
+        grid = mesh_mod._hybrid_device_grid(
+            {"dp": 2, "fsdp": 4}, {"dp": 2, "fsdp": 1},
+            mesh_mod._slice_groups(devs),
+        )
+        # fsdp (inner, all-ICI) never leaves a slice; dp crosses slices
+        for j in range(2):
+            assert {d.slice_index for d in grid[j]} == {j}
+
+    def test_unequal_slices_raise(self):
+        devs = [_FakeDev(0, 0), _FakeDev(1, 0), _FakeDev(2, 1)]
+        with pytest.raises(ValueError, match="devices; hybrid mesh needs"):
+            mesh_mod._hybrid_device_grid(
+                {"dp": 3}, {"dp": 1}, mesh_mod._slice_groups(devs)
+            )
+
+
+class TestBuildHybridMesh:
+    def test_default_axes_dp_over_slices_fsdp_within(self):
+        m = mesh_mod.build_hybrid_mesh(devices=_two_slices(4))
+        assert mesh_mod.mesh_shape(m) == {"dp": 2, "fsdp": 4}
+        for j in range(2):
+            assert {d.slice_index for d in m.devices[j].ravel()} == {j}
+
+    def test_explicit_axes_split_dp(self):
+        m = mesh_mod.build_hybrid_mesh({"dp": 4, "fsdp": 2}, devices=_two_slices(4))
+        assert mesh_mod.mesh_shape(m) == {"dp": 4, "fsdp": 2}
+        for j in range(4):
+            assert {d.slice_index for d in m.devices[j].ravel()} == {j // 2}
+
+    def test_single_slice_delegates_to_flat_build(self):
+        devs = [_FakeDev(i, 0) for i in range(4)]
+        m = mesh_mod.build_hybrid_mesh({"dp": -1}, devices=devs)
+        assert mesh_mod.mesh_shape(m) == {"dp": 4}
+
+    def test_drop_trivial_keeps_dcn_axes(self):
+        # fsdp==1 is droppable; dp carries the DCN factor and must survive
+        m = mesh_mod.build_hybrid_mesh(
+            {"dp": 2, "fsdp": 1}, devices=_two_slices(1), drop_trivial=True
+        )
+        assert mesh_mod.mesh_shape(m) == {"dp": 2}
+
+
+class TestBuildMeshDelegation:
+    """Satellite: build_mesh on a multi-slice world delegates to the hybrid
+    placement instead of warning about its own flat reshape."""
+
+    def test_multi_slice_delegates_silently(self, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING, logger=mesh_mod.__name__):
+            m = mesh_mod.build_mesh({"dp": 2, "fsdp": 4}, devices=_two_slices(4))
+        assert not caplog.records
+        assert mesh_mod.mesh_shape(m) == {"dp": 2, "fsdp": 4}
+        for j in range(2):
+            assert {d.slice_index for d in m.devices[j].ravel()} == {j}
+
+    def test_unplaceable_falls_back_to_flat_with_warning(self, caplog):
+        import logging
+
+        # dp=3 cannot absorb the 2-slice DCN dimension -> hybrid placement
+        # fails, the old flat reshape (and its warning) is the fallback
+        devs = [_FakeDev(i, i // 3) for i in range(6)]
+        with caplog.at_level(logging.WARNING, logger=mesh_mod.__name__):
+            m = mesh_mod.build_mesh({"dp": 3, "tp": 2}, devices=devs)
+        assert any("flat reshape" in r.getMessage() for r in caplog.records)
+        assert mesh_mod.mesh_shape(m) == {"dp": 3, "tp": 2}
+
+
+# -- single-process overlap scheduler ------------------------------------------
+
+
+def _mlp_setup(fsdp=False):
+    import jax
+    import optax
+
+    from tensorflowonspark_tpu import parallel
+    from tensorflowonspark_tpu.train import SyncDataParallel
+
+    mesh = parallel.local_mesh({"dp": 4, "fsdp": 2} if fsdp else {"dp": -1})
+    strategy = SyncDataParallel(mesh, fsdp=fsdp)
+
+    def init_fn(rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "w1": jax.random.normal(k1, (64, 64)) * 0.1,
+            "w2": jax.random.normal(k2, (64, 8)) * 0.1,
+        }
+
+    def loss_fn(params, batch):
+        import jax.numpy as jnp
+
+        h = jnp.tanh(batch["x"] @ params["w1"])
+        return jnp.mean((h @ params["w2"] - batch["y"]) ** 2)
+
+    return strategy, init_fn, loss_fn, optax.adam(1e-2)
+
+
+def _microbatches(strategy, rng, n, rows=8):
+    return [
+        strategy.shard_batch(
+            {
+                "x": rng.normal(size=(rows, 64)).astype(np.float32),
+                "y": rng.normal(size=(rows, 8)).astype(np.float32),
+            }
+        )
+        for _ in range(n)
+    ]
+
+
+class TestBucketedOverlap:
+    def _losses(self, overlap, steps=4, bucket_bytes=4096):
+        import jax
+
+        from tensorflowonspark_tpu.train import BucketedOverlap
+
+        strategy, init_fn, loss_fn, opt = _mlp_setup()
+        state = strategy.create_state(init_fn, opt, jax.random.PRNGKey(0))
+        sched = BucketedOverlap(
+            strategy, loss_fn, opt, bucket_bytes=bucket_bytes, overlap=overlap
+        )
+        rng = np.random.default_rng(11)
+        mbs = _microbatches(strategy, rng, 3)  # fixed: loss must descend
+        losses = []
+        for _ in range(steps):
+            state, metrics = sched.step(state, mbs)
+            losses.append(float(metrics["loss"]))
+        stats = dict(sched.last_stats)
+        sched.close()
+        return losses, stats
+
+    def test_on_off_bit_identical_and_training_progresses(self):
+        on, stats_on = self._losses(True)
+        off, stats_off = self._losses(False)
+        assert on == off, (on, off)  # bitwise: same programs, same order
+        assert on[-1] < on[0]
+        # overlap=False joins the comm thread before the next dispatch, so
+        # by construction no comm second coincides with later device work
+        assert stats_off["overlap_fraction"] == 0.0
+        assert stats_on["overlap_fraction"] > 0.0, stats_on
+
+    def test_multiple_buckets_partition(self):
+        import jax
+
+        from tensorflowonspark_tpu.train import BucketedOverlap
+
+        strategy, init_fn, loss_fn, opt = _mlp_setup()
+        state = strategy.create_state(init_fn, opt, jax.random.PRNGKey(0))
+        sched = BucketedOverlap(strategy, loss_fn, opt, bucket_bytes=4096)
+        rng = np.random.default_rng(1)
+        sched.step(state, _microbatches(strategy, rng, 1))
+        # w1 (16 KiB) exceeds the 4 KiB bound -> its own bucket; w2 fits
+        assert len(sched._buckets) == 2, sched._buckets
+        sched.close()
+
+    def test_rejects_fsdp_strategy(self):
+        from tensorflowonspark_tpu.train import BucketedOverlap
+
+        strategy, _, loss_fn, opt = _mlp_setup(fsdp=True)
+        with pytest.raises(ValueError, match="replicated params"):
+            BucketedOverlap(strategy, loss_fn, opt)
+
+    def test_empty_microbatches_raise(self):
+        import jax
+
+        from tensorflowonspark_tpu.train import BucketedOverlap
+
+        strategy, init_fn, loss_fn, opt = _mlp_setup()
+        state = strategy.create_state(init_fn, opt, jax.random.PRNGKey(0))
+        sched = BucketedOverlap(strategy, loss_fn, opt)
+        with pytest.raises(ValueError, match="at least one microbatch"):
+            sched.step(state, [])
+
+
+class TestFsdpOverlay:
+    def test_gauge_counts_sharded_params(self):
+        import jax
+        import optax
+
+        from tensorflowonspark_tpu import obs, parallel
+        from tensorflowonspark_tpu.train import SyncDataParallel
+
+        strategy = SyncDataParallel(
+            parallel.local_mesh({"dp": 4, "fsdp": 2}), fsdp=True,
+            min_weight_size=1,
+        )
+
+        def init_fn(rng):
+            k1, k2 = jax.random.split(rng)
+            return {
+                "w1": jax.random.normal(k1, (64, 64)),
+                "w2": jax.random.normal(k2, (64, 8)),
+            }
+
+        state = strategy.create_state(init_fn, optax.sgd(0.1), jax.random.PRNGKey(0))
+        # both leaves have a dim divisible by the 2-way fsdp axis
+        specs = [leaf.sharding.spec for leaf in jax.tree.leaves(state.params)]
+        assert all(
+            any("fsdp" in ((ax,) if isinstance(ax, str) else tuple(ax or ()))
+                for ax in spec)
+            for spec in specs
+        ), specs
+        snap = obs.snapshot()
+        assert snap["gauges"]["fsdp_params_sharded"]["value"] == 2
+
+    def test_overlay_respects_existing_specs_and_threshold(self):
+        from jax.sharding import PartitionSpec as P
+
+        from tensorflowonspark_tpu import parallel
+        from tensorflowonspark_tpu.parallel.sharding import overlay_fsdp_specs
+
+        mesh = parallel.local_mesh({"dp": 4, "fsdp": 2})
+        params = {
+            "big": np.zeros((64, 64), np.float32),
+            "tiny": np.zeros((4,), np.float32),
+            "taken": np.zeros((64, 64), np.float32),
+        }
+        specs = {"big": P(), "tiny": P(), "taken": P(None, "fsdp")}
+        out = overlay_fsdp_specs(params, specs, mesh, min_weight_size=64)
+        assert out["taken"] == P(None, "fsdp")  # already on fsdp: untouched
+        assert out["tiny"] == P()  # under the threshold: replicated
+        assert "fsdp" in [ax for ax in out["big"] if ax]  # sharded
+
+
+# -- multi-process gloo worlds -------------------------------------------------
+
+
+def _world_member(pid, num_procs, coord_port, out_dir, scenario):
+    """One gloo world member (module-level: spawn-picklable)."""
+    from tensorflowonspark_tpu.testing import join_cpu_world
+
+    join_cpu_world(pid, num_procs, coord_port, local_devices=1)
+    import time
+
+    import jax
+
+    from tensorflowonspark_tpu import chaos
+    from tensorflowonspark_tpu.parallel.hostreduce import HostAllReduceGroup
+    from tensorflowonspark_tpu.train import BucketedOverlap
+
+    out = {"pid": pid}
+    with HostAllReduceGroup(pid, num_procs) as group:
+        # raw collective determinism: distinct per-rank payloads, exact mean
+        buf = np.arange(8, dtype=np.float32) + 10.0 * pid
+        reduced = group.allreduce_mean(buf)
+        expect = np.mean(
+            [np.arange(8, dtype=np.float32) + 10.0 * r for r in range(num_procs)],
+            axis=0,
+        )
+        out["reduce_exact"] = bool(np.array_equal(reduced, expect))
+
+        strategy, init_fn, loss_fn, opt = _mlp_setup()
+
+        if scenario == "chaos":
+            # every rank installs the same single-victim plan: rank 0's
+            # link straggles; victim gating must leave rank 1's budget at 0
+            plan = chaos.ChaosPlan(seed=5).site(
+                "comm.link_delay", probability=1.0, delay_s=0.08, victim=0
+            )
+            chaos.install(plan, propagate=False)
+
+        def run(overlap, steps):
+            state = strategy.create_state(init_fn, opt, jax.random.PRNGKey(0))
+            sched = BucketedOverlap(
+                strategy, loss_fn, opt, group=group, bucket_bytes=1 << 14,
+                overlap=overlap,
+            )
+            rng = np.random.default_rng(100 + pid)  # per-rank data
+            mbs = _microbatches(strategy, rng, 2)  # fixed: loss must descend
+            losses, times = [], []
+            for _ in range(steps):
+                t0 = time.perf_counter()
+                state, metrics = sched.step(state, mbs)
+                times.append(time.perf_counter() - t0)
+                losses.append(float(metrics["loss"]))
+            sched.close()
+            return losses, times
+
+        out["losses_on"], out["times_on"] = run(True, 4)
+        out["losses_off"], out["times_off"] = run(False, 4)
+        if scenario == "chaos":
+            out["fired"] = chaos.plan().fired()
+            chaos.uninstall()
+            out["losses_clean"], out["times_clean"] = run(True, 4)
+
+    with open(os.path.join(out_dir, "rank{}.json".format(pid)), "w") as f:
+        json.dump(out, f)
+
+
+def _run_world(tmp_path, num_procs, scenario="plain"):
+    import functools
+
+    coord_port = util.find_free_port()
+    procs = [
+        util.spawn_process(
+            functools.partial(
+                _world_member, pid, num_procs, coord_port, str(tmp_path), scenario
+            ),
+            name="mc-{}".format(pid),
+        )
+        for pid in range(num_procs)
+    ]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=300)
+    assert all(p.exitcode == 0 for p in procs), [p.exitcode for p in procs]
+    results = []
+    for pid in range(num_procs):
+        with open(tmp_path / "rank{}.json".format(pid)) as f:
+            results.append(json.load(f))
+    return results
+
+
+@pytest.mark.slow
+def test_two_rank_determinism_and_overlap(tmp_path):
+    """2-rank gloo world: the host all-reduce is exact and rank-order
+    deterministic, every rank sees the same loss trajectory (it is a global
+    mean), and the trajectory is bit-identical with overlap on or off."""
+    results = _run_world(tmp_path, 2)
+    assert all(r["reduce_exact"] for r in results), results
+    # loss is reduced across ranks: identical everywhere, in both modes
+    assert results[0]["losses_on"] == results[1]["losses_on"]
+    assert results[0]["losses_on"] == results[0]["losses_off"]
+    assert results[0]["losses_off"] == results[1]["losses_off"]
+    # and training moved
+    assert results[0]["losses_on"][-1] < results[0]["losses_on"][0]
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    (os.cpu_count() or 1) < 4,
+    reason="4 lockstep jax worlds need >= 4 cores to measure anything",
+)
+def test_four_rank_weak_scaling_smoke(tmp_path):
+    """4-rank smoke: the group and scheduler hold at the widest CI world."""
+    results = _run_world(tmp_path, 4)
+    assert all(r["reduce_exact"] for r in results), results
+    first = results[0]["losses_on"]
+    assert all(r["losses_on"] == first for r in results)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_comm_link_delay_straggler(tmp_path):
+    """comm.link_delay on rank 0: the world degrades gracefully (losses stay
+    bit-identical across ranks and modes), the victim's budget is the only
+    one spent, and the straggle is visible in every rank's step-time
+    distribution — sync data parallelism is lockstep, one slow link slows
+    the world; uninstalling the plan brings step times back down."""
+    results = _run_world(tmp_path, 2, scenario="chaos")
+    # determinism survives the straggler
+    assert results[0]["losses_on"] == results[1]["losses_on"]
+    assert results[0]["losses_on"] == results[0]["losses_off"]
+    # victim gating: rank 0 fired, rank 1's identical plan spent nothing
+    assert results[0]["fired"] > 0
+    assert results[1]["fired"] == 0
+    # straggle shows in the per-rank spread: chaos-window step times sit
+    # well above the clean window on BOTH ranks (the delay propagates
+    # through the collective), and recover once the plan is gone
+    for r in results:
+        chaos_p50 = float(np.median(r["times_on"][1:]))
+        clean_p50 = float(np.median(r["times_clean"][1:]))
+        assert chaos_p50 > clean_p50 + 0.05, (r["pid"], chaos_p50, clean_p50)

@@ -90,7 +90,7 @@ class TestCollectives:
             return collectives.psum(x, "dp"), collectives.pmean(x, "dp")
 
         x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
-        s, mu = jax.shard_map(f, mesh=m, in_specs=P("dp"), out_specs=P("dp"))(x)
+        s, mu = parallel.shard_map(f, mesh=m, in_specs=P("dp"), out_specs=P("dp"))(x)
         np.testing.assert_allclose(np.asarray(s), np.full((8, 1), 28.0))
         np.testing.assert_allclose(np.asarray(mu), np.full((8, 1), 3.5))
 
@@ -101,7 +101,7 @@ class TestCollectives:
             return collectives.ring_shift(x, "dp")
 
         x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
-        out = np.asarray(jax.shard_map(f, mesh=m, in_specs=P("dp"), out_specs=P("dp"))(x))
+        out = np.asarray(parallel.shard_map(f, mesh=m, in_specs=P("dp"), out_specs=P("dp"))(x))
         np.testing.assert_array_equal(out[:, 0], np.roll(np.arange(8), 1))
 
     def test_reduce_scatter(self):
@@ -112,7 +112,7 @@ class TestCollectives:
 
         # every member holds the full vector; each ends up with its summed slice
         x = jnp.arange(8, dtype=jnp.float32)
-        out = np.asarray(jax.shard_map(f, mesh=m, in_specs=P(), out_specs=P("dp"))(x))
+        out = np.asarray(parallel.shard_map(f, mesh=m, in_specs=P(), out_specs=P("dp"))(x))
         np.testing.assert_allclose(out, np.arange(8, dtype=np.float32) * 8.0)
 
 

@@ -267,7 +267,7 @@ class TestMultiSliceWarning:
         with caplog.at_level("WARNING", logger="tensorflowonspark_tpu.parallel.mesh"):
             slices = mesh._warn_if_multi_slice(devs)
         assert slices == {0, 1}
-        assert any("create_hybrid_device_mesh" in r.message for r in caplog.records)
+        assert any("build_hybrid_mesh" in r.message for r in caplog.records)
 
     def test_single_slice_is_silent(self, caplog):
         from tensorflowonspark_tpu.parallel import mesh

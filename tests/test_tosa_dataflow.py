@@ -5,10 +5,11 @@ plus the cross-rule interaction coverage ISSUE 9 asks for: block-scoped
 suppressions and baseline fingerprints for project-level findings.
 """
 
+import os
 import textwrap
 import unittest
 
-from tosa_testutil import LIB_PATH, core, run_project_rule
+from tosa_testutil import LIB_PATH, REPO_ROOT, core, run_project_rule
 
 
 def _src(body):
@@ -211,6 +212,63 @@ class TestDonationSafety(unittest.TestCase):
                 return state, batch
             """
         )})
+        self.assertEqual(findings, [])
+
+
+class TestBucketedOverlapDonation(unittest.TestCase):
+    """Pins the BucketedOverlap donation contract: a grad program that
+    donated its params would invalidate the buffers every later microbatch
+    (and the comm thread's in-flight bucket fetches) still reference."""
+
+    def test_donating_grad_fn_fires(self):
+        # the shape BucketedOverlap must never take: donate params to the
+        # grad program, then keep handing them out for the next microbatch
+        # while the first's grads sit on the comm queue
+        findings = run_project_rule("donation-safety", {LIB_PATH: _src(
+            """
+            import jax
+
+            def dispatch(loss_fn, params, b1, jobs):
+                gfn = jax.jit(jax.value_and_grad(loss_fn), donate_argnums=(0,))
+                loss1, g1 = gfn(params, b1)
+                jobs.put(g1)
+                return params
+            """
+        )})
+        self.assertEqual(len(findings), 1)
+        self.assertIn("read after being donated", findings[0].message)
+
+    def test_overlap_shape_stays_clean(self):
+        # the in-tree shape: grad program donates nothing; only the apply
+        # program donates, after the comm drain, and its result is rebound
+        findings = run_project_rule("donation-safety", {LIB_PATH: _src(
+            """
+            import jax
+
+            def dispatch(loss_fn, apply, params, opt_state, b1, b2, jobs):
+                gfn = jax.jit(jax.value_and_grad(loss_fn), donate_argnums=())
+                loss1, g1 = gfn(params, b1)
+                jobs.put(g1)
+                loss2, g2 = gfn(params, b2)
+                jobs.put(g2)
+                apply_fn = jax.jit(apply, donate_argnums=(0, 1))
+                params, opt_state = apply_fn(params, opt_state, g1)
+                return params, opt_state, loss2
+            """
+        )})
+        self.assertEqual(findings, [])
+
+    def test_in_tree_scheduler_stays_clean(self):
+        # the rule over the real module: the shipped scheduler never reads
+        # a donated buffer (grad fns donate nothing, apply rebinds)
+        path = os.path.join(
+            REPO_ROOT, "tensorflowonspark_tpu", "train", "strategy.py"
+        )
+        with open(path) as f:
+            src = f.read()
+        findings = run_project_rule(
+            "donation-safety", {"tensorflowonspark_tpu/train/strategy.py": src}
+        )
         self.assertEqual(findings, [])
 
 
