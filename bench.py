@@ -1505,6 +1505,25 @@ def main():
         result = bench_multichip()
     else:
         result = bench_resnet(tiny, real_data=(mode != "resnet"))
+    if os.environ.get("TOS_TRACE_DIR"):
+        # tracing plane active for this bench run: merge the flight shards
+        # next to them and report where the step timeline landed (the JSON
+        # line stays the contract — the trace is a side artifact)
+        try:
+            from tensorflowonspark_tpu.obs import tracemerge
+
+            trace, summary = tracemerge.merge_directory(os.environ["TOS_TRACE_DIR"])
+            out = os.path.join(os.environ["TOS_TRACE_DIR"], "trace.json")
+            with open(out, "w") as f:
+                json.dump(trace, f)
+            result["trace"] = {
+                "path": out,
+                "events": summary["events"],
+                "shards": len(summary["shards"]),
+                "overlap_fraction": summary["overlap_fraction"],
+            }
+        except Exception as e:
+            result["trace"] = {"error": str(e)}
     print(json.dumps(result))
 
 

@@ -48,6 +48,9 @@ MODULES = [
     "tensorflowonspark_tpu.obs.aggregate",
     "tensorflowonspark_tpu.obs.exporter",
     "tensorflowonspark_tpu.obs.trace",
+    "tensorflowonspark_tpu.obs.tracing",
+    "tensorflowonspark_tpu.obs.flight",
+    "tensorflowonspark_tpu.obs.tracemerge",
     "tensorflowonspark_tpu.parallel.mesh",
     "tensorflowonspark_tpu.parallel.sharding",
     "tensorflowonspark_tpu.parallel.collectives",

@@ -7,11 +7,18 @@
 # With pyspark installed: additionally boots a local-cluster master so the
 # integration tests can target real Spark executors.
 #
-# Usage: ./run_tests.sh [--quick] [--chaos] [--perf-smoke] [--analyze]
-#                       [--native-sanitize] [--multichip] [extra pytest args]
+# Usage: ./run_tests.sh [--quick] [--chaos] [--perf-smoke] [--trace-smoke]
+#                       [--analyze] [--native-sanitize] [--multichip]
+#                       [extra pytest args]
 #   --quick       run the quick tier only (pytest -m 'not slow')
 #   --chaos       run the quick tier under a fixed low-probability ChaosPlan and
 #                 assert that at least one fault was actually injected
+#   --trace-smoke run the tracing-plane end-to-end leg: a 1-executor train
+#                 with TOS_TRACE_DIR set (flight shards from driver, executor,
+#                 and jax child) under a benign one-shot chaos fault, then
+#                 merge the shards and validate the Chrome trace schema
+#                 (required keys, monotone ts per track, matched B/E pairs)
+#                 and that the fault force-dumped a flight ring
 #   --multichip   run only the multi-process gloo legs: 2-rank host all-reduce
 #                 determinism + bucketed-overlap smoke (always), and the 4-rank
 #                 weak-scaling smoke (skips cleanly on hosts under 4 cores
@@ -40,6 +47,7 @@ cd "$(dirname "$0")"
 
 CHAOS=0
 PERF_SMOKE=0
+TRACE_SMOKE=0
 NATIVE_SANITIZE=0
 MULTICHIP=0
 EXTRA=()
@@ -51,6 +59,8 @@ for arg in "$@"; do
     EXTRA+=(-m "not slow")
   elif [[ "$arg" == "--perf-smoke" ]]; then
     PERF_SMOKE=1
+  elif [[ "$arg" == "--trace-smoke" ]]; then
+    TRACE_SMOKE=1
   elif [[ "$arg" == "--analyze" ]]; then
     exec python -m tosa --json --out tosa-report.json --sarif-out tosa-report.sarif
   elif [[ "$arg" == "--native-sanitize" ]]; then
@@ -64,8 +74,8 @@ done
 
 # static-analysis gate, two-phase (per-file walks + project-wide index):
 # jit purity/host-sync, retry & lock discipline, lock-order deadlock
-# detection, chaos-obs coverage, import hygiene, donation safety, and the
-# metrics contract (rule catalog: docs/analysis.md)
+# detection, chaos-obs coverage, import hygiene, donation safety, the
+# metrics contract, and trace discipline (rule catalog: docs/analysis.md)
 python -m tosa
 
 export JAX_PLATFORMS=cpu
@@ -123,14 +133,47 @@ if [[ "$PERF_SMOKE" == "1" ]]; then
   exec python -m pytest tests/ -q -m perf_smoke ${EXTRA[@]+"${EXTRA[@]}"}
 fi
 
+if [[ "$TRACE_SMOKE" == "1" ]]; then
+  # tracing-plane end-to-end proof: a 1-executor train records flight shards
+  # from every tier (driver, Spark executor, jax child), a benign one-shot
+  # chaos fault forces a ring dump, and the merged Chrome trace must pass
+  # schema validation with the lifecycle spans and the dump marker present
+  # on one trace id.
+  export TOS_TRACE_DIR="$(mktemp -d /tmp/tos_trace_smoke.XXXXXX)"
+  export TOS_CHAOS_PLAN='{"seed": 7, "sites": {"feed.stall": {"probability": 1.0, "max_count": 1, "delay_s": 0.01}}}'
+  echo "trace-smoke leg: recording under $TOS_TRACE_DIR"
+  python -m pytest tests/test_trace_smoke.py -q
+  python -m tensorflowonspark_tpu.obs.tracemerge --dir "$TOS_TRACE_DIR" \
+    --check --summary \
+    --require-span node_main --require-span feed_wave \
+    --require-event flight_dump --require-same-trace
+  echo "trace-smoke leg: merged Chrome trace at $TOS_TRACE_DIR/trace.json"
+  exit 0
+fi
+
 if [[ "$CHAOS" == "1" ]]; then
   # node.kill leg (first, before the benign env plan is exported — the test
   # installs its own single-victim plan): the recovery ladder under a
   # deterministic victim kill — blacklist after repeated loss, shrink-to-fit
   # relaunch, resharded resume, recovery counters asserted from the merged
   # cluster metrics.
-  echo "chaos leg: node.kill recovery-ladder run"
+  #
+  # The kill leg and the watchdog lease-expiry leg record into one flight
+  # root on one pinned trace id (tracing.mint adopts TOS_TRACE_ID), so the
+  # victim child's last spans, the watchdog's lease_expired verdict, and
+  # the ladder's relaunch spans land on ONE causally-ordered timeline —
+  # asserted post-hoc by tracemerge --check below.
+  export TOS_TRACE_DIR="$(mktemp -d /tmp/tos_trace_chaos.XXXXXX)"
+  export TOS_TRACE_ID="$(python -c 'import secrets; print(secrets.token_hex(16))')"
+  echo "chaos leg: node.kill recovery-ladder run (flight recording at $TOS_TRACE_DIR)"
   python -m pytest tests/test_elastic.py -q -m "chaos and slow"
+  echo "chaos leg: watchdog lease-expiry run (same trace id)"
+  python -m pytest "tests/test_watchdog.py::test_lease_expiry_names_the_executor_for_the_ledger" -q
+  python -m tensorflowonspark_tpu.obs.tracemerge --dir "$TOS_TRACE_DIR" --check \
+    --require-span node_main --require-span elastic_relaunch \
+    --require-event lease_expired --require-same-trace
+  echo "chaos leg: flight recording merged clean ($TOS_TRACE_DIR/trace.json)"
+  unset TOS_TRACE_DIR TOS_TRACE_ID
   # control-plane leg (also self-installed plans): control.driver_crash
   # drops the membership registry mid-watch (after control.journal_tear
   # tore the manifest publish) — recovery replays the journal, re-adopts

@@ -26,7 +26,9 @@ import time as _time
 from tensorflowonspark_tpu import TFSparkNode, TFManager, chaos, reservation, resilience
 from tensorflowonspark_tpu import registry as membership
 from tensorflowonspark_tpu.obs import aggregate as obs_aggregate
+from tensorflowonspark_tpu.obs import flight as obs_flight
 from tensorflowonspark_tpu.obs import registry as obs_registry
+from tensorflowonspark_tpu.obs import tracing as obs_tracing
 
 logger = logging.getLogger(__name__)
 
@@ -317,6 +319,14 @@ class TFCluster:
                         "node {}:{} stopped heartbeating: lease expired after "
                         "{:.0f}s without renewal (executor {})".format(job, task, age, eid)
                     )
+                    # the watchdog verdict is a black-box moment: stamp it on
+                    # the trace (the merged timeline shows the kill -> expiry
+                    # -> relaunch chain) and flush the driver's flight shard
+                    obs_tracing.event(
+                        "lease_expired", executor=eid, job=job, task_index=task,
+                        age_s=round(age, 3),
+                    )
+                    obs_flight.dump("lease_expired:executor{}".format(eid))
                 for eid in sorted(p for p in problems if p not in reported):
                     reported.add(eid)
                     logger.error("watchdog: %s", problems[eid])
@@ -985,12 +995,15 @@ def run(
         "reservation_timeout": reservation_timeout,
         # a driver-installed chaos plan rides the env lane so executors /
         # jax children on OTHER hosts (no shared os.environ) inherit it;
-        # an explicit user-provided TOS_CHAOS_PLAN in env wins
-        "env": (
-            {chaos.ENV_VAR: chaos.plan().to_json(), **dict(env or {})}
-            if chaos.active
-            else dict(env or {})
-        ),
+        # an explicit user-provided TOS_CHAOS_PLAN in env wins. The trace
+        # context (TOS_TRACE_ID / parent span / TOS_TRACE_DIR) rides the
+        # same lane: mint() is idempotent, so a ladder relaunch reuses the
+        # trace_id and the whole recovery stays one causal timeline.
+        "env": {
+            **obs_tracing.mint(proc="driver"),
+            **({chaos.ENV_VAR: chaos.plan().to_json()} if chaos.active else {}),
+            **dict(env or {}),
+        },
         "jax_distributed": bool(jax_distributed),
         "tensorboard": bool(tensorboard),
         "log_dir": log_dir,

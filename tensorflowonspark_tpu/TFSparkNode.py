@@ -32,8 +32,10 @@ import traceback
 from tensorflowonspark_tpu import TFManager, TFNode, chaos, reservation, resilience, tpu_info, util
 from tensorflowonspark_tpu.marker import Chunk, EndPartition
 from tensorflowonspark_tpu.obs import aggregate as obs_aggregate
+from tensorflowonspark_tpu.obs import flight as obs_flight
 from tensorflowonspark_tpu.obs import registry as obs_registry
 from tensorflowonspark_tpu.obs import trace as obs_trace
+from tensorflowonspark_tpu.obs import tracing as obs_tracing
 
 #: rows per proxied queue message on the feed plane (amortizes the Manager
 #: round trip that was the reference's hot-loop bottleneck; overridable for
@@ -218,6 +220,12 @@ def _child_entry(fn, tf_args, ctx, cluster_meta, error_queue_spec):
         # the chaos module already ran its import-time env check in this
         # interpreter — re-check now that the lane has landed
         chaos._install_from_env()
+        # adopt the cluster trace context the same way: spans below (and in
+        # forked decode workers, which inherit this environ) carry the
+        # driver-minted trace_id, and this child gets its own flight shard
+        obs_tracing.install_from_env(
+            "jax-{}-{}".format(ctx.job_name, ctx.task_index)
+        )
         os.environ.update(tpu_info.visibility_env(platform=env.get("JAX_PLATFORMS")))
         if env.get("JAX_PLATFORMS"):
             # config-API forcing: on TPU-pod images the site setup pins the
@@ -255,9 +263,21 @@ def _child_entry(fn, tf_args, ctx, cluster_meta, error_queue_spec):
         _drain_checkpoints()
         publisher.stop()  # final flush: short runs publish at least once
         ctx.mgr.set("child_status", "done")
-    except BaseException:
+    except BaseException as child_exc:
         tb = traceback.format_exc()
         logger.error("user main_fun failed:\n%s", tb)
+        # black-box moment: an unhandled child exit stamps the trace and
+        # flushes this process's flight shard so the post-mortem merge shows
+        # the child's final spans even when the process is about to die
+        try:
+            obs_tracing.event(
+                "child_failed",
+                job=ctx.job_name, task_index=ctx.task_index,
+                executor_id=ctx.executor_id, error=type(child_exc).__name__,
+            )
+            obs_flight.dump("child_failed:{}".format(type(child_exc).__name__))
+        except Exception:
+            pass
         # land any in-flight async checkpoint BEFORE reporting the failure:
         # the relaunched attempt resumes from the newest committed one
         _drain_checkpoints()
@@ -412,6 +432,15 @@ class _NodeLaunchTask:
 
         template = meta["cluster_template"]
         job_name, task_index = template[executor_id]
+        # adopt the driver-minted trace context BEFORE the REG handshake:
+        # the node_launch span below carries the cluster trace_id, and the
+        # REG round-trip's driver-stamped reply seeds this host's clock
+        # offset (obs.tracing.observe_clock) for the trace merger. Folding
+        # the meta env lane into os.environ here also means the spawned jax
+        # child and anything it forks inherit the context.
+        obs_tracing.install_from_env(
+            "executor{}".format(executor_id), env=meta.get("env") or {}
+        )
         authkey = meta["authkey"]
         # every channel is TCP ('remote'): the driver shuts nodes down by
         # posting end-of-feed directly to each node's queues — deterministic,

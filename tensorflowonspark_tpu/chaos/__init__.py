@@ -279,6 +279,13 @@ def _record(site):
     obs.counter("chaos_fault_{}_total".format(safe), help="chaos faults at {}".format(site)).inc()
     with obs.span("chaos_fault", site=site):
         pass  # marker span: wall-clock point of injection for trace ordering
+    # black-box moment: a fault injection flushes this process's flight
+    # shard (no-op when the tracing plane is inert), so even a fault that
+    # kills the process leaves its final spans on disk
+    try:
+        obs.flight_dump("chaos:{}".format(site))
+    except Exception:  # the dump is best-effort, the fault must still fire
+        pass
     logger.warning("chaos: injected fault at %s", site)
     log_path = os.environ.get(LOG_ENV_VAR)
     if log_path:

@@ -346,11 +346,14 @@ class FeedAutotuner:
         nbytes = sum(
             leaf.nbytes for batch in window for leaf in jax.tree.leaves(batch)
         )
-        t0 = self._clock()
-        self._fire_link_chaos()
-        placed = packed_place(window, strategy)
-        self._fence(placed)
-        self.note_transfer(nbytes, self._clock() - t0)
+        # the h2d phase of the step timeline: the same fenced interval that
+        # feeds the estimator becomes a span for the merged trace
+        with obs.span("h2d_transfer", nbytes=nbytes, k=len(window)):
+            t0 = self._clock()
+            self._fire_link_chaos()
+            placed = packed_place(window, strategy)
+            self._fence(placed)
+            self.note_transfer(nbytes, self._clock() - t0)
         return AutotunedWindow(placed, len(window))
 
 

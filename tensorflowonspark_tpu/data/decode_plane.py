@@ -132,6 +132,14 @@ def _worker_main(conn, parse_fn):
     # the parent's SIGINT belongs to the training process; workers die by
     # pipe EOF (retire/teardown) or SIGKILL (crash/chaos) only
     signal.signal(signal.SIGINT, signal.SIG_IGN)
+    # fork carries the parent's trace context in os.environ: adopt it under
+    # this worker's own proc label so the flight recorder opens a fresh
+    # shard (never interleaving the parent's), and stamp the fork on the
+    # timeline. No-ops entirely when no trace is active.
+    from tensorflowonspark_tpu.obs import tracing as obs_tracing
+
+    obs_tracing.install_from_env("decode-worker")
+    obs_tracing.event("decode_worker_start", pid=os.getpid())
     into = getattr(parse_fn, "into", None)
     slabs = {}  # name -> SlabSegment kept attached across rounds
     while True:

@@ -42,6 +42,8 @@ import time
 
 from tensorflowonspark_tpu import TFCluster, TFSparkNode, obs, reservation
 from tensorflowonspark_tpu import registry as membership
+from tensorflowonspark_tpu.obs import flight as obs_flight
+from tensorflowonspark_tpu.obs import tracing as obs_tracing
 
 logger = logging.getLogger(__name__)
 
@@ -411,6 +413,15 @@ def run_ladder(
         # -- the ladder: classify → budget-check → blacklist → shrink ---------
         t0 = time.monotonic()
         event = ledger.record(classify_failure(failure, role_map=role_map))
+        # black-box moment: the classified failure goes onto the trace (same
+        # trace_id as the killed child's last spans and the watchdog's
+        # lease_expired event — mint() is idempotent across relaunches) and
+        # the driver's flight shard is flushed before the recovery decision
+        obs_tracing.event(
+            "failure_classified", kind=event.kind,
+            executor_ids=sorted(event.executor_ids), attempt=relaunches + 1,
+        )
+        obs_flight.dump("failure_classified:{}".format(event.kind))
         obs.counter(
             "recovery_attempts_total", help="failed cluster attempts entering recovery"
         ).inc()
@@ -430,63 +441,67 @@ def run_ladder(
                 )
             ) from failure
 
-        if regrow and blacklist:
-            # a relaunch resumes from the latest checkpoint, so this IS the
-            # checkpoint boundary: re-probe condemned executors and forgive
-            # the ones that come back healthy
-            recovered = sorted(
-                blacklist - set(preflight_executors(sc, sorted(blacklist), extra_probe))
-            )
-            for eid in recovered:
-                blacklist.discard(eid)
-                ledger.clear(eid)
-                registry.forgive(eid)
-            if recovered:
-                logger.info("regrow: executors %s passed re-probe; unblacklisted",
-                            recovered)
-        blacklist.update(ledger.suspects())
-        for eid in sorted(blacklist):
-            registry.blacklist(eid, reason=event.kind)
-
-        # shrink to surviving capacity, then preflight the actual candidates;
-        # gate failures shrink further (and can trip the min_workers floor)
-        while True:
-            new_target = plan_size(
-                num_executors, blacklist, min_workers=min_workers, overhead=overhead
-            )
-            candidates = sorted(
-                TFCluster.build_cluster_template(
-                    new_target,
-                    run_kwargs.get("num_ps", 0),
-                    run_kwargs.get("master_node", "chief"),
-                    run_kwargs.get("eval_node", False),
-                    blacklist=blacklist,
+        # the relaunch decision is itself a span: the merged timeline shows
+        # kill -> lease_expired -> failure_classified -> elastic_relaunch in
+        # causal order on one trace
+        with obs.span("elastic_relaunch", attempt=relaunches, kind=event.kind):
+            if regrow and blacklist:
+                # a relaunch resumes from the latest checkpoint, so this IS the
+                # checkpoint boundary: re-probe condemned executors and forgive
+                # the ones that come back healthy
+                recovered = sorted(
+                    blacklist - set(preflight_executors(sc, sorted(blacklist), extra_probe))
                 )
-            )
-            if not preflight:
-                break
-            bad = preflight_executors(sc, candidates, extra_probe)
-            if not bad:
-                break
-            for eid, reason in sorted(bad.items()):
-                logger.warning("blacklisting executor %s: %s", eid, reason)
-                registry.blacklist(eid, reason="preflight: {}".format(reason))
-            blacklist.update(bad)
-        if new_target < target:
+                for eid in recovered:
+                    blacklist.discard(eid)
+                    ledger.clear(eid)
+                    registry.forgive(eid)
+                if recovered:
+                    logger.info("regrow: executors %s passed re-probe; unblacklisted",
+                                recovered)
+            blacklist.update(ledger.suspects())
+            for eid in sorted(blacklist):
+                registry.blacklist(eid, reason=event.kind)
+
+            # shrink to surviving capacity, then preflight the actual candidates;
+            # gate failures shrink further (and can trip the min_workers floor)
+            while True:
+                new_target = plan_size(
+                    num_executors, blacklist, min_workers=min_workers, overhead=overhead
+                )
+                candidates = sorted(
+                    TFCluster.build_cluster_template(
+                        new_target,
+                        run_kwargs.get("num_ps", 0),
+                        run_kwargs.get("master_node", "chief"),
+                        run_kwargs.get("eval_node", False),
+                        blacklist=blacklist,
+                    )
+                )
+                if not preflight:
+                    break
+                bad = preflight_executors(sc, candidates, extra_probe)
+                if not bad:
+                    break
+                for eid, reason in sorted(bad.items()):
+                    logger.warning("blacklisting executor %s: %s", eid, reason)
+                    registry.blacklist(eid, reason="preflight: {}".format(reason))
+                blacklist.update(bad)
+            if new_target < target:
+                obs.counter(
+                    "recovery_shrinks_total",
+                    help="relaunches that shrank the cluster to surviving capacity",
+                ).inc()
+            obs.gauge(
+                "executors_blacklisted", help="executors currently blacklisted"
+            ).set(len(blacklist))
             obs.counter(
-                "recovery_shrinks_total",
-                help="relaunches that shrank the cluster to surviving capacity",
-            ).inc()
-        obs.gauge(
-            "executors_blacklisted", help="executors currently blacklisted"
-        ).set(len(blacklist))
-        obs.counter(
-            "recovery_seconds_total",
-            help="wall seconds spent in recovery (failure to relaunch decision)",
-        ).inc(time.monotonic() - t0)
-        logger.warning(
-            "cluster attempt %d failed (%s: %s); relaunching with %d executor(s)%s",
-            relaunches, event.kind, failure, new_target,
-            " (blacklist: {})".format(sorted(blacklist)) if blacklist else "",
-        )
-        target = new_target
+                "recovery_seconds_total",
+                help="wall seconds spent in recovery (failure to relaunch decision)",
+            ).inc(time.monotonic() - t0)
+            logger.warning(
+                "cluster attempt %d failed (%s: %s); relaunching with %d executor(s)%s",
+                relaunches, event.kind, failure, new_target,
+                " (blacklist: {})".format(sorted(blacklist)) if blacklist else "",
+            )
+            target = new_target

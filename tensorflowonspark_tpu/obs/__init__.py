@@ -15,6 +15,15 @@ Layers (data flows left to right):
 * :mod:`~tensorflowonspark_tpu.obs.trace` — lifecycle spans (reservation,
   node launch, feed waves, checkpoint, serving) recorded as structured
   events with wall + monotonic timestamps.
+* :mod:`~tensorflowonspark_tpu.obs.tracing` — cluster-wide trace context:
+  a ``trace_id``/root ``span_id`` minted by ``TFCluster.run`` and threaded
+  through the env lane to every tier, plus NTP-style clock-offset
+  estimation from the reservation handshake.
+* :mod:`~tensorflowonspark_tpu.obs.flight` — per-process crash-safe JSONL
+  ring shards under ``TOS_TRACE_DIR`` (CRC line framing + tmp/rename
+  segment commits), dumped on chaos faults, failure classification, and
+  unhandled child exit. Merged offline by
+  :mod:`~tensorflowonspark_tpu.obs.tracemerge` into one Chrome-trace JSON.
 * :mod:`~tensorflowonspark_tpu.obs.aggregate` — executor-side nodes publish
   registry snapshots over the existing TFManager channel; the driver merges
   them into one cluster view (``TFCluster.metrics()``).
@@ -38,3 +47,4 @@ from tensorflowonspark_tpu.obs.registry import (  # noqa: F401
     snapshot,
 )
 from tensorflowonspark_tpu.obs.trace import span  # noqa: F401
+from tensorflowonspark_tpu.obs.flight import dump as flight_dump  # noqa: F401

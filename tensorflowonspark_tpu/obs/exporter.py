@@ -8,9 +8,17 @@ view (``TFCluster.metrics()``) as easily as a single process's registry.
 
 Endpoints (:class:`MetricsHTTPServer`):
 
-* ``GET /metrics``       → Prometheus text format, ``text/plain; version=0.0.4``
-* ``GET /metrics.json``  → the raw snapshot dict as JSON (tests, bench.py)
-* anything else          → 404
+* ``GET /metrics``         → Prometheus text format, ``text/plain; version=0.0.4``
+* ``GET /metrics.json``    → the raw snapshot dict as JSON (tests, bench.py)
+* ``GET /trace``           → this process's flight-recorder shard as JSON
+  (``{"records": [...], "torn": N, "shard": path}``) — the raw span/event
+  stream :mod:`~tensorflowonspark_tpu.obs.tracemerge` stitches cluster-wide,
+  reachable per process while the run is still alive
+* ``GET /histograms.json`` → per-histogram quantile summaries
+  (``{name: {p50, p99, count, sum}}``) — the step-phase duration
+  distributions (``step_fetch_seconds`` … ``step_compute_seconds``) the
+  profiler records, without pulling full bucket arrays
+* anything else            → 404
 
 Prometheus rendering notes:
 
@@ -90,6 +98,54 @@ def render_json(snap):
     return json.dumps(snap, sort_keys=True)
 
 
+def histogram_quantile(hist_snap, q):
+    """Estimate quantile ``q`` from one histogram snapshot by linear
+    interpolation inside the containing bucket (the textbook
+    ``histogram_quantile`` estimator; observations above the last finite
+    bound clamp to that bound)."""
+    count = hist_snap.get("count", 0)
+    if count <= 0:
+        return None
+    rank = q * count
+    cumulative = 0
+    lower = 0.0
+    buckets = hist_snap.get("buckets") or []
+    for le, n in buckets:
+        if cumulative + n >= rank and n > 0:
+            frac = (rank - cumulative) / n
+            return lower + (le - lower) * min(1.0, max(0.0, frac))
+        cumulative += n
+        lower = le
+    return buckets[-1][0] if buckets else None
+
+
+def render_quantiles(snap, quantiles=(0.5, 0.99)):
+    """Per-histogram quantile summary of a snapshot: the compact view of the
+    step-phase duration distributions the profiler records."""
+    out = {}
+    for name, h in sorted((snap.get("histograms") or {}).items()):
+        row = {"count": h.get("count", 0), "sum": h.get("sum", 0.0)}
+        for q in quantiles:
+            row["p{:g}".format(q * 100).replace(".", "_")] = histogram_quantile(h, q)
+        out[name] = row
+    return out
+
+
+def local_trace():
+    """This process's flight shard as a JSON-able dict (the /trace body).
+
+    Reads the shard back from disk (not memory) so the endpoint shows
+    exactly what a post-mortem merge would see; empty when the tracing
+    plane is inert."""
+    from tensorflowonspark_tpu.obs import flight
+
+    rec = flight.current(create=False)
+    if rec is None:
+        return {"records": [], "torn": 0, "shard": None}
+    records, torn = flight.read_shard(rec.shard_dir)
+    return {"records": records, "torn": torn, "shard": rec.shard_dir}
+
+
 class MetricsHTTPServer:
     """Tiny threaded HTTP server exposing a snapshot function.
 
@@ -114,6 +170,12 @@ class MetricsHTTPServer:
                         ctype = CONTENT_TYPE
                     elif self.path == "/metrics.json":
                         body = render_json(snap).encode("utf-8")
+                        ctype = "application/json"
+                    elif self.path == "/histograms.json":
+                        body = json.dumps(render_quantiles(snap), sort_keys=True).encode("utf-8")
+                        ctype = "application/json"
+                    elif self.path == "/trace":
+                        body = json.dumps(local_trace(), sort_keys=True).encode("utf-8")
                         ctype = "application/json"
                     else:
                         self.send_error(404)

@@ -233,6 +233,14 @@ class Registry:
     def add_event(self, event):
         if not self._enabled:
             return
+        # the deque is bounded: appending at capacity silently evicts the
+        # oldest event, which must not be invisible — count every drop so
+        # operators can tell a quiet run from a clipped event window
+        if len(self._events) == self._events.maxlen:
+            self.counter(
+                "obs_events_dropped_total",
+                help="events evicted from the bounded buffer (oldest-first)",
+            ).inc()
         self._events.append(event)
 
     def events(self):

@@ -20,9 +20,11 @@ When the registry is disabled, :func:`span` returns a shared no-op context
 manager: no allocation, nothing recorded.
 """
 
+import threading
 import time
 
 from tensorflowonspark_tpu.obs import registry as _registry
+from tensorflowonspark_tpu.obs import tracing as _tracing
 
 
 class _NullSpan:
@@ -44,7 +46,7 @@ _NULL = _NullSpan()
 
 
 class Span:
-    __slots__ = ("name", "attrs", "_registry", "_t0_wall", "_t0_mono")
+    __slots__ = ("name", "attrs", "_registry", "_t0_wall", "_t0_mono", "_span_id", "_parent_id")
 
     def __init__(self, name, registry, attrs):
         self.name = name
@@ -57,12 +59,17 @@ class Span:
         return self
 
     def __enter__(self):
+        # participate in the cluster trace when a context is installed: the
+        # thread-local stack gives this span an id + its parent, so nested
+        # spans chain causally across every tier for free
+        self._span_id, self._parent_id = _tracing.push_span()
         self._t0_wall = time.time()
         self._t0_mono = time.monotonic()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         dur = time.monotonic() - self._t0_mono
+        _tracing.pop_span(self._span_id)
         event = {
             "span": self.name,
             "ts": self._t0_wall,
@@ -71,6 +78,23 @@ class Span:
         }
         if self.attrs:
             event.update(self.attrs)
+        if self._span_id is not None:
+            event["trace"] = _tracing.trace_id()
+            event["span_id"] = self._span_id
+            _tracing.record(
+                {
+                    "kind": "span",
+                    "name": self.name,
+                    "trace": _tracing.trace_id(),
+                    "span": self._span_id,
+                    "parent": self._parent_id,
+                    "ts": self._t0_wall,
+                    "dur_s": dur,
+                    "ok": exc_type is None,
+                    "tid": threading.get_native_id(),
+                    "attrs": dict(self.attrs) if self.attrs else {},
+                }
+            )
         self._registry.add_event(event)
         self._registry.histogram(
             self.name + "_seconds", help="duration of {} spans".format(self.name)

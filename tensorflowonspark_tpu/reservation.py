@@ -32,6 +32,7 @@ import threading
 import time
 
 from tensorflowonspark_tpu import chaos, obs, resilience
+from tensorflowonspark_tpu.obs import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -402,7 +403,10 @@ class Server:
                 "reservation_registrations_total",
                 help="REG messages accepted (retries re-register idempotently)",
             ).inc()
-            msock.send({"type": "OK"})
+            # the reply carries the driver's wall clock: the client folds the
+            # stamped round-trip into its NTP-style clock-offset estimate so
+            # the trace merger can align per-host timelines (obs.tracing)
+            msock.send({"type": "OK", "ts": time.time()})
         elif kind == "QUERY":
             msock.send({"type": "DONE", "data": self.reservations.done})
         elif kind == "QINFO":
@@ -502,12 +506,19 @@ class Client:
             raise ConnectionResetError("chaos: injected connection reset")
         with socket.create_connection(self.server_addr, timeout=self.timeout) as sock:
             msock = MessageSocket(sock)
+            t0 = time.time()
             msock.send(msg)
             reply = msock.recv()
+            t1 = time.time()
             if reply is None:
                 raise ReservationError("server closed connection")
             if reply.get("type") == "ERROR":
                 raise ReservationError(str(reply.get("data")))
+            # driver-stamped replies double as clock-sync samples: per-attempt
+            # wall clocks bracket exactly one round-trip (retries would
+            # inflate the RTT and poison the NTP-style midpoint estimate)
+            if "ts" in reply:
+                tracing.observe_clock(float(reply["ts"]), t0, t1)
             return reply
 
     def _request(self, msg):

@@ -351,6 +351,12 @@ class ReplicaServer:
 def _replica_child_main(model, host, conn, poll_interval, trusted_builder):
     """Forked-process replica entry point: serve, report the bound address
     through the pipe, then wait for SIGTERM."""
+    # the replica inherits the mesh's trace context through os.environ at
+    # spawn; adopt it under a replica proc label so its spans land in a
+    # shard of their own (no-op when tracing is inert)
+    from tensorflowonspark_tpu.obs import tracing as obs_tracing
+
+    obs_tracing.install_from_env("serving-replica")
     stop_evt = threading.Event()
 
     def _on_term(_signum, _frame):
@@ -795,6 +801,13 @@ class ReplicaRouter:
     # -- routing core ----------------------------------------------------------
 
     def _request(self, kind, payload):
+        # one span per routed client request: failovers/hedges happen inside
+        # it, so a merged timeline shows routing latency per request with
+        # the cluster trace_id the mesh process inherited at spawn
+        with obs.span("serving_route", kind=kind):
+            return self._route(kind, payload)
+
+    def _route(self, kind, payload):
         deadline = resilience.Deadline(self.deadline)
         started = time.monotonic()
         last_err = None

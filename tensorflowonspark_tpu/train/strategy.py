@@ -626,11 +626,31 @@ class BucketedOverlap:
         )
         self.last_stats = stats
         from tensorflowonspark_tpu import obs
+        from tensorflowonspark_tpu.obs import tracing as obs_tracing
 
         obs.gauge(
             "comm_overlap_fraction",
             help="fraction of host all-reduce time hidden behind device backprop",
         ).set(stats["overlap_fraction"])
+        if obs_tracing.active():
+            # publish the comm thread's measured intervals as retroactive
+            # spans on the dedicated comm track: perf_counter -> wall via a
+            # single anchor, comm_window marking where later backprop could
+            # hide each bucket — tracemerge recomputes the overlap fraction
+            # from exactly these drawn spans to corroborate the gauge
+            anchor = time.time() - time.perf_counter()
+            for i, rec in enumerate(records):
+                for s, e in rec["comm_spans"]:
+                    obs_tracing.record_span(
+                        "comm_allreduce", ts=anchor + s, dur_s=e - s,
+                        track="comm", microbatch=i,
+                    )
+                if i + 1 < len(records) and window_end > records[i + 1]["dispatch_ts"]:
+                    win0 = records[i + 1]["dispatch_ts"]
+                    obs_tracing.record_span(
+                        "comm_window", ts=anchor + win0, dur_s=window_end - win0,
+                        track="comm_window", microbatch=i,
+                    )
         metrics = {"loss": loss_mean, "step": new_state.step}
         return new_state, metrics
 
@@ -734,15 +754,31 @@ def run_steps(step_fn, state, batches, engine=None, save_every_n=None, hooks=())
     cadence = save_every_n if save_every_n is not None else (
         engine.save_every_n if engine is not None else 0
     )
+    from tensorflowonspark_tpu import obs
+
+    # per-step phase spans (fetch / compute / snapshot): each lands in the
+    # flight shard for the merged step timeline AND in the {phase}_seconds
+    # histogram the exporter's /histograms.json summarizes as p50/p99.
+    # obs.span hands out a shared no-op span when collection is disabled.
     metrics = None
+    it = iter(batches)
+    i = 0
     try:
-        for i, batch in enumerate(batches):
-            state, metrics = step_fn(state, batch)
+        while True:
+            with obs.span("step_fetch", step=start_step + i + 1):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
+            with obs.span("step_compute", step=start_step + i + 1):
+                state, metrics = step_fn(state, batch)
             global_step = start_step + i + 1
             for hook in hooks:
                 hook(state, global_step, metrics)
             if engine is not None and cadence and global_step % cadence == 0:
-                engine.save(state, global_step)
+                with obs.span("ckpt_snapshot", step=global_step):
+                    engine.save(state, global_step)
+            i += 1
     finally:
         if engine is not None:
             engine.drain()

@@ -14,6 +14,7 @@ from .lock_discipline import LockDisciplineChecker
 from .lock_order import LockOrderChecker
 from .metrics_contract import MetricsContractChecker
 from .retry_discipline import RetryDisciplineChecker
+from .trace_discipline import TraceDisciplineChecker
 
 ALL_CHECKERS = {
     cls.rule: cls
@@ -27,6 +28,7 @@ ALL_CHECKERS = {
         ImportHygieneChecker,
         DonationSafetyChecker,
         MetricsContractChecker,
+        TraceDisciplineChecker,
     )
 }
 

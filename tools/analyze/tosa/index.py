@@ -515,6 +515,12 @@ class _FunctionExtractor(ast.NodeVisitor):
         """'global' when the receiver is the shared obs registry module,
         'var:<name>' for a local Registry() instance, 'other' otherwise."""
         head = recv.split(".")[0]
+        if head == "self" and self.mod.relpath.replace("\\", "/").endswith(
+            "obs/registry.py"
+        ):
+            # Registry methods registering on themselves ARE the global
+            # registry's own bookkeeping (e.g. obs_events_dropped_total).
+            return "global"
         target = self.mod.imports.get(head, "")
         if target == "tensorflowonspark_tpu.obs" or target.startswith(
             "tensorflowonspark_tpu.obs."
@@ -541,6 +547,7 @@ class _ModuleExtractor:
             "classes": {},
             "functions": {},
             "chaos": None,
+            "trace": None,
         }
 
     def extract(self):
@@ -552,6 +559,7 @@ class _ModuleExtractor:
             elif isinstance(node, ast.ClassDef):
                 self._class(node)
         self._chaos_facts()
+        self._trace_facts()
         return self.summary
 
     def _imports(self):
@@ -689,6 +697,44 @@ class _ModuleExtractor:
             facts["doc_line"] = self.tree.body[0].lineno if self.tree.body else 1
             facts["counter_in_source"] = COUNTER_NAME in self.source
         self.summary["chaos"] = facts
+
+    def _trace_facts(self):
+        """Literal span sites (and, for obs/tracing.py, the docstring
+        span-site table) — the cross-file half of trace-discipline so the
+        rule still runs when per-file walks are cache hits."""
+        from .checkers.trace_discipline import (
+            SITE_LINE_RE,
+            SPAN_FUNCS,
+            TRACE_RECEIVERS,
+            _in_obs_package,
+            _is_tracing_module,
+        )
+
+        fires = []
+        if not _in_obs_package(self.relpath):
+            for node in ast.walk(self.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func) or ""
+                parts = name.split(".")
+                if (
+                    len(parts) == 2
+                    and parts[0] in TRACE_RECEIVERS
+                    and parts[1] in SPAN_FUNCS
+                ):
+                    lit = _literal_str(node.args[0]) if node.args else None
+                    if lit is not None:
+                        fires.append([lit, node.lineno])
+        facts = {"fires": fires}
+        if _is_tracing_module(self.relpath):
+            doc = ast.get_docstring(self.tree) or ""
+            facts["table"] = [
+                m.group("site")
+                for m in (SITE_LINE_RE.match(line) for line in doc.splitlines())
+                if m
+            ]
+            facts["doc_line"] = self.tree.body[0].lineno if self.tree.body else 1
+        self.summary["trace"] = facts
 
 
 def summarize(tree, source, relpath):
