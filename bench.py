@@ -503,7 +503,20 @@ def bench_resnet(tiny, real_data):
                 tr_rates.append(tr)
                 ratios.append(tr / nc)
                 rate_est = nc
-            valid, invalid = partition_pairs(nc_rates, tr_rates)
+            # validity band by regime (see bench_lm): when the producer spent
+            # more time blocked on a full prefetch queue than the consumer
+            # spent starved, the model dispatch is the gate and tr/nc << 1
+            # is physics, not a mood shift — only "train cannot beat its own
+            # input path" can invalidate a pair there. On TPU hosts the run
+            # is input-bound and the symmetric band applies unchanged.
+            from tensorflowonspark_tpu import obs as _obs
+
+            _snap = _obs.snapshot()["counters"]
+            _emit = _snap.get("data_producer_emit_seconds_total", {}).get("value", 0.0)
+            _wait = _snap.get("data_consumer_wait_seconds_total", {}).get("value", 0.0)
+            valid, invalid = partition_pairs(
+                nc_rates, tr_rates, min_ratio=0.0 if _emit >= _wait else None
+            )
             print(
                 "resnet_real pairs: train {} img/s | input-path-only {} img/s | "
                 "per-pair ratios {} ({}){}".format(
@@ -569,9 +582,11 @@ def bench_resnet(tiny, real_data):
         # denominator falls back to it.
         vs_baseline = statistics.median(ratios)
         unit = (
-            "images/sec/chip (input-path-limited: median of {} train/"
+            "images/sec/chip ({}: median of {} train/"
             "input-path-only pair ratios, spread {:.2f}-{:.2f}, input path "
             "{:.0f} img/s/chip{})".format(
+                "compute-bound, input path is the ceiling"
+                if _emit >= _wait else "input-path-limited",
                 len(ratios), ratio_spread[0], ratio_spread[1],
                 link_ceiling, ", packed windows" if packed else ""
             )
@@ -690,25 +705,69 @@ def bench_mnist_epoch():
     }
 
 
+def make_lm_corpus(out_dir, n_records, seed=0, mean_words=20.0, sigma=0.6):
+    """Deterministic synthetic text corpus as raw-record TFRecord shards:
+    word counts ~ lognormal (a realistic short-document shape whose FFD
+    packing lands well above the 0.85 efficiency bar), words drawn from a
+    small varied-length vocabulary. Returns the shard paths."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import tfrecord
+
+    words = (
+        "the spark cluster streams tokenized text through shared memory "
+        "slabs while accelerator meshes consume packed sequences of "
+        "variable length records keeping every chip busy with deterministic "
+        "batches and counters tracking efficiency under load"
+    ).split()
+    rng = np.random.default_rng(seed)
+    shards = 4
+    per_shard = max(1, n_records // shards)
+    for s in range(shards):
+        path = os.path.join(out_dir, "part-{:05d}".format(s))
+        with tfrecord.TFRecordWriter(path) as w:
+            for _ in range(per_shard):
+                n = max(3, int(rng.lognormal(mean=float(np.log(mean_words)), sigma=sigma)))
+                w.write(" ".join(rng.choice(words, size=n)).encode("utf-8"))
+    return tfrecord.list_shards(out_dir)
+
+
 def bench_lm(tiny):
-    """Transformer LM training throughput, tokens/sec/chip — the
-    beyond-parity flagship (flash attention at long context): fwd+bwd+adamw
-    on synthetic tokens, bf16, seq BENCH_SEQ (default 4096; by 8192 plain
-    XLA attention fails to compile the score matrix outright — docs/perf.md). vs_baseline is MXU utilization: achieved model FLOP/s
-    (6 * params * tokens/s) over the chip's bf16 peak."""
+    """Transformer LM fine-tune throughput over the REAL packed-text input
+    path, tokens/sec/chip: TFRecord text shards -> tokenize -> FFD sequence
+    packing (TextPipeline, [B, seq+1] with segment fencing) -> fwd+bwd+adamw
+    with the segment-masked loss. Measured with the train-vs-input-only
+    pair methodology established for resnet_real: N same-size block pairs
+    (a NO-COMPUTE block consuming the identical packed/placed stream with
+    the train dispatch removed, and a TRAIN block), order alternating,
+    headline = median train rate of the valid pairs, vs_baseline = median
+    train/input-path ratio (~1.0 = compute hidden behind the input path).
+    The JSON also reports the packing table: measured efficiency (real-
+    token fraction), pad fraction, sequences/tokens packed, truncations."""
+    import shutil
+    import statistics
+    import sys
+    import tempfile
+
     import jax
-    import jax.numpy as jnp
     import numpy as np
     import optax
 
-    from tensorflowonspark_tpu import parallel
+    from tensorflowonspark_tpu import obs, parallel
+    from tensorflowonspark_tpu.data import TextPipeline, Tokenizer
     from tensorflowonspark_tpu.models import transformer
     from tensorflowonspark_tpu.train import SyncDataParallel
 
     n_chips = jax.device_count()
-    seq = int(os.environ.get("BENCH_SEQ", 64 if tiny else 4096))
+    seq = int(os.environ.get("BENCH_SEQ", 64 if tiny else 1024))
     batch = int(os.environ.get("BENCH_BATCH", 2 if tiny else 4)) * n_chips
-    steps = int(os.environ.get("BENCH_STEPS", 2 if tiny else 10))
+    # dispatches per timed block: long enough that the ~1 prefetched batch
+    # riding across the timing fence biases a block by at most ~1/steps
+    steps = int(os.environ.get("BENCH_STEPS", 4 if tiny else 16))
+    reps = int(os.environ.get("BENCH_REPS", 2 if tiny else 6))
+    budget = float(os.environ.get("BENCH_TIME_BUDGET", "360"))
+    pack_workers = int(os.environ.get("BENCH_PACK_WORKERS", "0"))
+
     mesh = parallel.build_mesh({"dp": n_chips})
     strategy = SyncDataParallel(mesh)
     model = transformer.create_model(
@@ -718,7 +777,7 @@ def bench_lm(tiny):
         n_layers=2 if tiny else 4,
         n_heads=4 if tiny else 16,
         d_ff=128 if tiny else 4096,
-        max_seq_len=seq, dtype="float32" if tiny else "bfloat16",
+        max_seq_len=seq + 1, dtype="float32" if tiny else "bfloat16",
     )
     optimizer = optax.adamw(1e-4)
     state = strategy.create_state(
@@ -728,29 +787,175 @@ def bench_lm(tiny):
     step = strategy.compile_train_step(
         transformer.make_loss_fn(model), optimizer, has_aux=True
     )
-    rng = np.random.default_rng(0)
-    sharded = strategy.shard_batch(
-        {"tokens": rng.integers(0, 1000, (batch, seq + 1))}
-    )
-    for _ in range(2):
-        state, metrics = step(state, sharded)
-    float(np.asarray(jax.device_get(metrics["loss"])))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, sharded)
-    float(np.asarray(jax.device_get(metrics["loss"])))
-    dt = time.perf_counter() - t0
-    tokens_s = batch * seq * steps / dt / n_chips
-    # 6*N FLOPs per token (fwd+bwd), v5e bf16 peak 197 TFLOP/s
-    mxu_util = 6.0 * n_params * tokens_s / 197e12
-    return {
-        "metric": "transformer_lm_tokens_per_sec_per_chip",
-        "value": round(tokens_s, 1),
-        "unit": "tokens/sec/chip (seq {}, {:.0f}M params, flash attention)".format(
-            seq, n_params / 1e6
-        ),
-        "vs_baseline": round(mxu_util, 4),
-    }
+
+    tmp = tempfile.mkdtemp(prefix="bench_lm_corpus_")
+    try:
+        # enough distinct records that blocks never ship the same bytes
+        # back-to-back; epochs=None repeats the corpus across blocks
+        files = make_lm_corpus(tmp, n_records=max(4096, 8 * batch * (seq // 20 + 1)))
+        tokenizer = Tokenizer(kind="word", vocab_size=1024 if tiny else 32000)
+        pipe = TextPipeline(
+            files, tokenizer, seq_len=seq + 1, batch_size=batch,
+            seed=0, epochs=None, pack_workers=pack_workers,
+            prefetch_batches=4,
+        )
+        stream = iter(pipe)
+        batches = (strategy.shard_batch(b) for b in stream)
+        tokens_per_dispatch = batch * seq  # [B, seq+1] slots -> seq targets
+
+        def _fence(x):
+            leaf = jax.tree.leaves(x)[0]
+            _ = np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
+
+        # compile + first-batch warm-up
+        for _ in range(2):
+            state, metrics = step(state, next(batches))
+        float(np.asarray(jax.device_get(metrics["loss"])))
+
+        def _no_compute_block(d):
+            # the full input path — tokenize, pack, place — through the very
+            # same generator, with the train dispatch removed
+            _fence(next(batches))
+            t0 = time.perf_counter()
+            buf = None
+            for _ in range(d):
+                buf = next(batches)
+            _fence(buf)
+            return d * tokens_per_dispatch / (time.perf_counter() - t0)
+
+        def _train_block(d):
+            nonlocal state, metrics
+            state, metrics = step(state, next(batches))  # absorb dispatch
+            float(np.asarray(jax.device_get(metrics["loss"])))
+            t0 = time.perf_counter()
+            for _ in range(d):
+                state, metrics = step(state, next(batches))
+            # host transfer of the last loss is the only trustworthy fence
+            float(np.asarray(jax.device_get(metrics["loss"])))
+            return d * tokens_per_dispatch / (time.perf_counter() - t0)
+
+        # one warm-up pair, measured and discarded (cold page cache, cold
+        # packed-slab paths, unwarmed branch predictors)
+        warm_nc = _no_compute_block(steps)
+        warm_tr = _train_block(steps)
+        print(
+            "lm warm-up pair (measured, discarded): train {} | input-path {} "
+            "tok/s | ratio {:.3f}".format(
+                round(warm_tr / n_chips, 1), round(warm_nc / n_chips, 1),
+                warm_tr / warm_nc,
+            ),
+            file=sys.stderr,
+        )
+        rate_est = warm_nc
+        nc_rates, tr_rates = [], []
+        budget_exhausted = False
+        t_bench = time.perf_counter()
+        for pair in range(reps):
+            remaining = budget - (time.perf_counter() - t_bench)
+            min_pair_secs = 2 * (steps + 1) * tokens_per_dispatch / rate_est
+            if pair > 0 and remaining < 1.5 * min_pair_secs:
+                budget_exhausted = True
+                print(
+                    "budget exhausted after {} pair(s); stopping early".format(pair),
+                    file=sys.stderr,
+                )
+                break
+            if pair % 2 == 0:  # alternate order: mood drift cancels
+                nc = _no_compute_block(steps)
+                tr = _train_block(steps)
+            else:
+                tr = _train_block(steps)
+                nc = _no_compute_block(steps)
+            nc_rates.append(nc)
+            tr_rates.append(tr)
+            rate_est = nc
+        snap = obs.snapshot()
+
+        def _c(name):
+            return snap["counters"].get(name, {}).get("value", 0.0)
+
+        def _g(name):
+            return snap["gauges"].get(name, {}).get("value", 0.0)
+
+        read_s = round(_c("data_producer_read_seconds_total"), 3)
+        parse_s = round(_c("data_producer_parse_seconds_total"), 3)
+        emit_s = round(_c("data_producer_emit_seconds_total"), 3)
+        wait_s = round(_c("data_consumer_wait_seconds_total"), 3)
+        classification = classify_stalls(read_s, parse_s, emit_s, wait_s)
+        # validity band by regime: input-bound pairs measure the SAME
+        # bottleneck in both blocks, so a ratio far from 1.0 either way is
+        # a mood shift (the symmetric resnet_real band). A device-bound run
+        # (producer blocked on a full queue: the model is the gate) makes
+        # tr/nc << 1 the honest physics — there only "train cannot beat its
+        # own input path" (tr <= 1.10 * nc) can invalidate a pair.
+        device_bound = classification == "device_bound"
+        valid, invalid = partition_pairs(
+            nc_rates, tr_rates, min_ratio=0.0 if device_bound else None
+        )
+        print(
+            "lm pairs: train {} tok/s | input-path-only {} tok/s | per-pair "
+            "ratios {}{}".format(
+                [round(v / n_chips, 1) for v in tr_rates],
+                [round(v / n_chips, 1) for v in nc_rates],
+                [round(tr / nc, 3) for nc, tr in zip(nc_rates, tr_rates)],
+                " | {} invalid pair(s) discarded".format(len(invalid))
+                if invalid else "",
+            ),
+            file=sys.stderr,
+        )
+        if not valid:
+            best = least_implausible_pair(nc_rates, tr_rates)
+            print(
+                "all {} pairs invalid; keeping the least-implausible pair "
+                "(ratio {:.3f})".format(len(invalid), best[1] / best[0]),
+                file=sys.stderr,
+            )
+            valid = [best]
+        ratios = [tr / nc for nc, tr in valid]
+        value = statistics.median([tr for _nc, tr in valid]) / n_chips
+        input_path = statistics.median([nc for nc, _tr in valid]) / n_chips
+        result = {
+            "metric": "transformer_lm_train_tokens_per_sec_per_chip",
+            "value": round(value, 1),
+            "unit": (
+                "tokens/sec/chip (seq {}, {:.1f}M params, packed text "
+                "shards; {}: median of {} train/input-path pair ratios, "
+                "spread {:.2f}-{:.2f}, input path {:.0f} tok/s/chip)".format(
+                    seq, n_params / 1e6,
+                    "compute-bound, input path is the ceiling"
+                    if device_bound else "input-path-limited",
+                    len(ratios), min(ratios), max(ratios), input_path,
+                )
+            ),
+            "vs_baseline": round(statistics.median(ratios), 4),
+            "packing": {
+                "efficiency": round(_g("text_pack_efficiency"), 4),
+                "pad_fraction": round(_g("text_pad_fraction"), 4),
+                "sequences_packed": int(_c("text_sequences_packed_total")),
+                "tokens_packed": int(_c("text_tokens_packed_total")),
+                "sequences_truncated": int(_c("text_sequences_truncated_total")),
+                "pack_stall_seconds": round(_c("text_pack_stall_seconds_total"), 3),
+                "pack_workers": pack_workers,
+            },
+            "stalls": {
+                "producer_read_seconds": read_s,
+                "producer_parse_seconds": parse_s,
+                "producer_emit_seconds": emit_s,
+                "consumer_wait_seconds": wait_s,
+                "classification": classification,
+            },
+        }
+        result.update(confidence_fields(
+            len(nc_rates), reps, invalid_pairs=len(invalid),
+            budget_exhausted=budget_exhausted,
+        ))
+        return result
+    finally:
+        try:
+            stream.close()
+        except Exception:
+            pass
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def bench_feed_plane():

@@ -69,6 +69,8 @@ MODULES = [
     "tensorflowonspark_tpu.data.loader",
     "tensorflowonspark_tpu.data.autotune",
     "tensorflowonspark_tpu.data.decode_plane",
+    "tensorflowonspark_tpu.data.tokenizer",
+    "tensorflowonspark_tpu.data.text_plane",
     "tensorflowonspark_tpu.data.imagenet",
     "tensorflowonspark_tpu.data.cifar",
     "tensorflowonspark_tpu.models.mnist",

@@ -11,8 +11,12 @@ TPU-native capabilities the framework adds on top of reference parity:
 * mixture of experts (``--moe_experts N`` over an ``ep`` axis);
 * rematerialization (``--remat``) trading FLOPs for HBM.
 
-Data is a synthetic LM stream (seeded per worker) — the point here is the
-compute/parallelism path; plug a real corpus by replacing ``token_batches``.
+Data is real: TFRecord text shards stream through the sequence-packing
+:class:`~tensorflowonspark_tpu.data.TextPipeline` (per-worker file shards,
+FFD packing into ``[B, seq_len+1]`` with segment-id/position columns, the
+packed-slab cache with ``--slab_cache_dir``). Without ``--data_dir`` a
+deterministic synthetic corpus is materialized on the driver first — same
+plumbing, zero setup.
 
 Usage (single host):
     python examples/transformer/transformer_spark.py --train_steps 50 \
@@ -25,6 +29,38 @@ import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+#: word list for the synthetic corpus — varied lengths so FFD has real work
+_WORDS = (
+    "the spark cluster streams tokenized text through shared memory slabs "
+    "while accelerator meshes consume packed sequences of variable length "
+    "records a distributed pipeline keeps every chip busy with deterministic "
+    "batches and observability counters tracking efficiency"
+).split()
+
+
+def make_text_corpus(data_dir, num_shards=4, records_per_shard=512, seed=0):
+    """Materialize a deterministic synthetic text corpus as TFRecord shards
+    (raw UTF-8 records, the ``Tokenizer(field=None)`` shape). Record lengths
+    are lognormal-ish so sequence packing has a realistic distribution to
+    chew on. Idempotent: existing shards are reused."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import tfrecord as tfr
+
+    existing = tfr.list_shards(data_dir) if os.path.isdir(data_dir) else []
+    if len(existing) >= num_shards:
+        return existing
+    os.makedirs(data_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    for s in range(num_shards):
+        path = os.path.join(data_dir, "part-{:05d}".format(s))
+        with tfr.TFRecordWriter(path) as w:
+            for _ in range(records_per_shard):
+                n = max(3, int(rng.lognormal(mean=3.0, sigma=0.6)))
+                text = " ".join(rng.choice(_WORDS, size=n))
+                w.write(text.encode("utf-8"))
+    return tfr.list_shards(data_dir)
 
 
 def parse_mesh(spec):
@@ -84,17 +120,46 @@ def main_fun(args, ctx):
     else:
         run = strategy.compile_train_step(loss_fn, optimizer, has_aux=True)
 
-    def token_batches():
-        # synthetic LM stream: fixed per-worker seed; replace with a real
-        # corpus reader (e.g. data pipeline over tokenized TFRecords)
-        rng = np.random.default_rng(ctx.executor_id)
-        while True:
-            tokens = rng.integers(
-                0, args.vocab_size, (args.batch_size, args.seq_len + 1)
-            )
-            yield strategy.shard_batch({"tokens": tokens})
+    # real corpus: per-worker TFRecord text shards → tokenize → FFD-pack
+    # into [B, seq_len+1] (the +1 feeds the shift-by-one LM loss), with
+    # segment_ids/positions fencing packed sequences in the attention mask
+    from tensorflowonspark_tpu import obs
+    from tensorflowonspark_tpu import tfrecord as tfr
+    from tensorflowonspark_tpu.data import TextPipeline, Tokenizer, shard_files
 
-    batches = token_batches()
+    all_files = tfr.list_shards(args.data_dir)
+    files = shard_files(all_files, ctx.num_workers, ctx.executor_id)
+    if not files:
+        # fail loudly NOW: a worker with no data would sit out the
+        # collective train steps and hang the whole world at step 1
+        raise RuntimeError(
+            "worker {} got 0 of {} shard files in {} — distributed training "
+            "needs at least num_workers ({}) shard files".format(
+                ctx.executor_id, len(all_files), args.data_dir, ctx.num_workers
+            )
+        )
+    tokenizer = Tokenizer(
+        kind=args.tokenizer,
+        vocab_size=args.vocab_size if args.tokenizer == "word" else None,
+    )
+    if tokenizer.vocab_size > args.vocab_size:
+        raise ValueError(
+            "model vocab_size {} smaller than tokenizer vocab {}".format(
+                args.vocab_size, tokenizer.vocab_size
+            )
+        )
+    pipe = TextPipeline(
+        files, tokenizer, seq_len=args.seq_len + 1, batch_size=args.batch_size,
+        seed=ctx.executor_id, epochs=None, max_bad_records=args.max_bad_records,
+        pack_workers=args.pack_workers, slab_cache_dir=args.slab_cache_dir,
+    )
+    stream = iter(pipe)
+
+    def packed_batches():
+        for batch in stream:
+            yield strategy.shard_batch(batch)
+
+    batches = packed_batches()
     t0, metrics = time.perf_counter(), {}
     i = start_step
     while i < args.train_steps:
@@ -110,12 +175,18 @@ def main_fun(args, ctx):
             tps = args.batch_size * args.seq_len * (i - start_step) / dt
             print("step {}: loss {:.3f} ({:.0f} tokens/s)".format(
                 i, float(metrics["loss"]), tps))
+    stream.close()  # stop the producer (and the pack plane) before teardown
     if args.model_dir and (ctx.distributed or ctx.executor_id == 0):
         checkpoint.save_checkpoint(
             os.path.join(args.model_dir, "ckpt_{}".format(args.train_steps)),
             jax.device_get(state),
         )
-    print("transformer training complete: mesh={}".format(dict(zip(mesh.axis_names, mesh.devices.shape))))
+    print(
+        "transformer training complete: mesh={} packing_efficiency={:.3f}".format(
+            dict(zip(mesh.axis_names, mesh.devices.shape)),
+            obs.gauge("text_pack_efficiency").value,
+        )
+    )
 
 
 def main(argv=None, sc=None):
@@ -125,22 +196,35 @@ def main(argv=None, sc=None):
                         help="explicit cluster size (default: from the Spark conf/parallelism under Spark; 1 on the local backend)")
     parser.add_argument("--d_ff", type=int, default=1024)
     parser.add_argument("--d_model", type=int, default=256)
+    parser.add_argument("--data_dir", default=None,
+                        help="TFRecord text shards (raw UTF-8 records); default: a deterministic synthetic corpus materialized on the driver")
     parser.add_argument("--dtype", default="bfloat16")
     parser.add_argument("--learning_rate", type=float, default=3e-4)
     parser.add_argument("--log_steps", type=int, default=10)
+    parser.add_argument("--max_bad_records", type=int, default=0)
     parser.add_argument("--mesh", default=None,
                         help="e.g. dp=2,tp=2,sp=2 (default: all-dp)")
     parser.add_argument("--model_dir", default=None)
     parser.add_argument("--moe_experts", type=int, default=0)
     parser.add_argument("--n_heads", type=int, default=8)
     parser.add_argument("--n_layers", type=int, default=2)
+    parser.add_argument("--pack_workers", type=int, default=0,
+                        help="0 = in-process thread packing, N = forked pack-plane workers")
     parser.add_argument("--platform", default=None)
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--seq_len", type=int, default=256)
+    parser.add_argument("--slab_cache_dir", default=None,
+                        help="packed-slab cache root (epoch >= 2 serves token rows from a memory map)")
     parser.add_argument("--steps_per_loop", type=int, default=1)
+    parser.add_argument("--tokenizer", default="byte", choices=("byte", "word"))
     parser.add_argument("--train_steps", type=int, default=20)
     parser.add_argument("--vocab_size", type=int, default=1024)
     args = parser.parse_args(argv)
+
+    if not args.data_dir:
+        args.data_dir = os.path.join("/tmp", "tos_transformer_corpus")
+        shards = make_text_corpus(args.data_dir)
+        print("synthetic text corpus: {} shards in {}".format(len(shards), args.data_dir))
 
     from tensorflowonspark_tpu import TFCluster
 

@@ -126,10 +126,14 @@ fi
 if [[ "$PERF_SMOKE" == "1" ]]; then
   # covers the IO/parse overlap proof, the autotune adaptation leg
   # (tests/test_autotune.py::TestChaosDeviceLink) — both sleep-staged, no
-  # real accelerator or absolute-throughput assertion involved — and the
+  # real accelerator or absolute-throughput assertion involved — the
   # decode-plane GIL-release leg (tests/test_decode_plane.py::TestGilRelease:
   # process workers must beat one thread on a CPU-bound parse; skips
-  # cleanly on hosts with fewer than 4 cores where the race is meaningless)
+  # cleanly on hosts with fewer than 4 cores where the race is meaningless),
+  # and the lm leg (tests/test_text_pipeline.py::TestPerfSmokeLM: a tiny
+  # transformer fine-tunes through the packed TextPipeline and the
+  # train-vs-input-only pair methodology must yield a valid, non-discarded
+  # pair — the BENCH_MODE=lm shape in miniature)
   exec python -m pytest tests/ -q -m perf_smoke ${EXTRA[@]+"${EXTRA[@]}"}
 fi
 
@@ -194,6 +198,14 @@ if [[ "$CHAOS" == "1" ]]; then
   # visible in the per-rank step-time spread bucketed overlap reports.
   echo "chaos leg: comm.link_delay straggler run"
   python -m pytest tests/test_multichip.py -q -m "chaos and slow"
+  # text-plane leg (self-installed plans): data.tokenize_error swaps records
+  # for invalid UTF-8 on a live cluster — the skips must be charged against
+  # max_bad_records and surface as chaos_fault_data_tokenize_error_total /
+  # text_tokenize_errors_total in the merged cluster metrics; data.pack_stall
+  # delays inside packing and the stall classifier must call the job
+  # input-bound.
+  echo "chaos leg: text-plane tokenize_error/pack_stall run"
+  python -m pytest tests/test_chaos_text.py -q -m chaos
   # Benign-in-outcome sites at low probability: the suite's assertions
   # must keep passing — most sites only perturb timing; data.decode_kill
   # SIGKILLs a decode worker, which the plane's respawn-and-release
@@ -207,6 +219,7 @@ if [[ "$CHAOS" == "1" ]]; then
     "data.decode_kill":     {"probability": 0.05, "max_count": null},
     "data.cache_tear":      {"probability": 0.05, "max_count": null},
     "data.readahead_stall": {"probability": 0.05, "max_count": null, "delay_s": 0.01},
+    "data.pack_stall":      {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "serving.latency":      {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "reservation.slow_accept": {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "control.lease_delay":  {"probability": 0.05, "max_count": null, "delay_s": 0.005},

@@ -70,6 +70,15 @@ site                            effect at the injection point
                                 (probes and windows), so injected latency
                                 flows into the link estimate and the window
                                 size K must adapt
+``data.tokenize_error``         text producer swaps one record for invalid
+                                UTF-8 bytes; the tokenizer rejects it and the
+                                skip is charged against ``max_bad_records``
+                                identically in every pack mode (the length
+                                check runs producer-side)
+``data.pack_stall``             text packer sleeps ``delay_s`` inside the
+                                timed packing region, charged into parse time
+                                so ``classify_stalls`` reports the job
+                                input-bound (decode_bound)
 ``checkpoint.corrupt_write``    newest checkpoint left torn on disk (in the
                                 async engine: shard bitrot after the
                                 manifest, caught by cheap-verify)

@@ -37,4 +37,12 @@ from tensorflowonspark_tpu.data.decode_plane import (  # noqa: F401
     DecodeAutotuner,
     DecodePlane,
 )
+from tensorflowonspark_tpu.data.text_plane import (  # noqa: F401
+    TextPipeline,
+    pack_bins,
+)
+from tensorflowonspark_tpu.data.tokenizer import (  # noqa: F401
+    TokenizeError,
+    Tokenizer,
+)
 from tensorflowonspark_tpu.data import cifar, imagenet  # noqa: F401

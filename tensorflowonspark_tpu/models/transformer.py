@@ -88,40 +88,49 @@ _ATTENTION_IMPLS = ("auto", "flash", "plain", "ring")
 _FLASH_MIN_SEQ = int(os.environ.get("TOS_FLASH_MIN_SEQ", "256"))
 
 
-def _dispatch_attention(q, k, v, impl, mesh):
+def _dispatch_attention(q, k, v, impl, mesh, segment_ids=None):
     """Pick the attention path. ``auto``: ring over ``sp`` when the mesh
     shards the sequence, else the pallas flash kernel on TPU (plain below
     ``TOS_FLASH_MIN_SEQ``), else plain XLA attention. Forcing
     ``plain``/``flash``/``ring`` always wins (``plain`` on an sp mesh is the
     debugging escape hatch — correct, just unsharded math).
+
+    ``segment_ids`` (``int32 [B, L]``, 0 = padding) is the text plane's
+    packed-sequence fence — every path turns it into the same
+    block-diagonal mask, so packed neighbours never cross-attend.
     """
     if impl not in _ATTENTION_IMPLS:
         raise ValueError(
             "unknown attention impl {!r}; expected one of {}".format(impl, _ATTENTION_IMPLS)
         )
     if impl == "plain":
-        return plain_attention(q, k, v, causal=True)
+        return plain_attention(q, k, v, causal=True, segment_ids=segment_ids)
     has_sp = mesh is not None and "sp" in mesh.axis_names
     if impl == "ring" or (impl == "auto" and has_sp):
-        return ring_attention_sharded(q, k, v, mesh, causal=True)
+        return ring_attention_sharded(q, k, v, mesh, causal=True, segment_ids=segment_ids)
     if impl == "flash" or jax.default_backend() == "tpu":
         seq = q.shape[2]
         if impl != "flash" and seq < _FLASH_MIN_SEQ:
-            return plain_attention(q, k, v, causal=True)
+            return plain_attention(q, k, v, causal=True, segment_ids=segment_ids)
         from tensorflowonspark_tpu.ops.flash_attention import flash_attention
 
         pad = (-seq) % 128
         if pad:
             # causal masking means queries < seq never attend to the zero
-            # padding appended after them, so pad-run-slice is exact
+            # padding appended after them, so pad-run-slice is exact; with
+            # segments the appended columns get id 0, which never equals a
+            # real (>= 1) segment — exact for the same reason
             q, k, v = (
                 jnp.pad(t, ((0, 0), (0, 0), (0, pad), (0, 0))) for t in (q, k, v)
             )
+            if segment_ids is not None:
+                segment_ids = jnp.pad(segment_ids, ((0, 0), (0, pad)))
         out = flash_attention(
-            q, k, v, causal=True, interpret=jax.default_backend() != "tpu"
+            q, k, v, causal=True, segment_ids=segment_ids,
+            interpret=jax.default_backend() != "tpu",
         )
         return out[:, :, :seq] if pad else out
-    return plain_attention(q, k, v, causal=True)
+    return plain_attention(q, k, v, causal=True, segment_ids=segment_ids)
 
 
 class Attention(nn.Module):
@@ -129,7 +138,7 @@ class Attention(nn.Module):
     mesh: object = None  # jax.sharding.Mesh or None
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, segment_ids=None):
         cfg = self.cfg
         dt = cfg.compute_dtype
         dense = lambda name: nn.DenseGeneral(  # noqa: E731
@@ -139,7 +148,7 @@ class Attention(nn.Module):
         q = _rope(q, positions)
         k = _rope(k, positions)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B, H, L, D]
-        out = _dispatch_attention(q, k, v, cfg.attention, self.mesh)
+        out = _dispatch_attention(q, k, v, cfg.attention, self.mesh, segment_ids=segment_ids)
         out = out.transpose(0, 2, 1, 3)  # [B, L, H, D]
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), use_bias=False, dtype=dt, name="o"
@@ -231,9 +240,10 @@ class Block(nn.Module):
     mesh: object = None
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, segment_ids=None):
         x = x + Attention(self.cfg, self.mesh, name="attn")(
-            nn.RMSNorm(dtype=self.cfg.compute_dtype, name="ln1")(x), positions
+            nn.RMSNorm(dtype=self.cfg.compute_dtype, name="ln1")(x), positions,
+            segment_ids,
         )
         mlp = (
             MoeMlp(self.cfg, name="moe")
@@ -264,20 +274,23 @@ class Transformer(nn.Module):
         )
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, positions=None, segment_ids=None):
         cfg = self.cfg
         x = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=cfg.compute_dtype, name="embed"
         )(tokens)
         x = self._constrain(x)
-        positions = jnp.broadcast_to(
-            jnp.arange(tokens.shape[1])[None, :], tokens.shape
-        )
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1])[None, :], tokens.shape
+            )
         block = Block
         if cfg.remat:
             block = nn.remat(Block, static_argnums=())
         for i in range(cfg.n_layers):
-            x = block(cfg, self.mesh, name="layer_{}".format(i))(x, positions)
+            x = block(cfg, self.mesh, name="layer_{}".format(i))(
+                x, positions, segment_ids
+            )
             x = self._constrain(x)
         x = nn.RMSNorm(dtype=cfg.compute_dtype, name="ln_f")(x)
         logits = nn.Dense(
@@ -353,18 +366,38 @@ def make_init_fn(model, sample_len=16):
 def make_loss_fn(model):
     """Next-token LM loss; batch = {"tokens": int32 [B, L]} (optionally with
     {"mask": [B, L]} to exclude padding). MoE models contribute their sown
-    router load-balancing losses, weighted by ``cfg.moe_aux_weight``."""
+    router load-balancing losses, weighted by ``cfg.moe_aux_weight``.
+
+    Packed batches from the text plane additionally carry ``segment_ids``
+    and ``positions`` (``int32 [B, L]``): segments fence attention
+    block-diagonally, per-segment positions keep the rotary phase local,
+    and the loss drops targets that cross a pack boundary (the last token
+    of one sequence must not be asked to predict the first of the next) or
+    fall in padding."""
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]
+        seg = batch.get("segment_ids")
+        pos = batch.get("positions")
         logits, mods = model.apply(
-            {"params": params}, tokens[:, :-1], mutable=["losses"]
+            {"params": params}, tokens[:, :-1],
+            positions=None if pos is None else pos[:, :-1],
+            segment_ids=None if seg is None else seg[:, :-1],
+            mutable=["losses"],
         )
         targets = tokens[:, 1:]
         losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
         mask = batch.get("mask")
+        mask = None if mask is None else mask[:, 1:]
+        if seg is not None:
+            # a target is valid when its position and the position it is
+            # predicted from share a real (non-pad) segment — the last token
+            # of one packed sequence never predicts the first of the next
+            seg_mask = ((seg[:, 1:] == seg[:, :-1]) & (seg[:, 1:] > 0)).astype(
+                losses.dtype
+            )
+            mask = seg_mask if mask is None else mask * seg_mask
         if mask is not None:
-            mask = mask[:, 1:]
             loss = (losses * mask).sum() / jnp.maximum(mask.sum(), 1)
         else:
             loss = losses.mean()

@@ -40,7 +40,23 @@ def _causal_mask(s, iq, ik, block_q, block_k):
     return jnp.where(q_pos >= k_pos, s, _NEG_BIG)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *, scale, causal, block_q, block_k):
+def _segment_mask(s, sq_ref, sk_ref):
+    """Packed-sequence fence: scores survive only where the query's segment
+    id equals the key's. ``sq_ref`` blocks are [block_q, _STAT_W] (the same
+    broadcast-lane trick as the row statistics); ``sk_ref`` blocks come from
+    the pre-transposed [BH, _STAT_W, L] layout so the kernel reads a
+    [1, block_k] row directly — no in-kernel transpose."""
+    seg_q = sq_ref[0][:, :1]  # [bq, 1]
+    seg_k = sk_ref[0][:1, :]  # [1, bk]
+    return jnp.where(seg_q == seg_k, s, _NEG_BIG)
+
+
+def _fwd_kernel(*refs, scale, causal, segmented, block_q, block_k):
+    if segmented:
+        q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref, acc, m, l = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l = refs
+        sq_ref = sk_ref = None
     iq, ik = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -57,6 +73,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *, scale, causal
         ) * scale
         if causal:
             s = _causal_mask(s, iq, ik, block_q, block_k)
+        if segmented:
+            s = _segment_mask(s, sq_ref, sk_ref)
         m_new = jnp.maximum(m[:], jnp.max(s, axis=1, keepdims=True))
         corr = jnp.exp(m[:] - m_new)
         p = jnp.exp(s - m_new)
@@ -83,7 +101,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l, *, scale, causal
         lse_ref[0] = jnp.broadcast_to(m[:] + jnp.log(denom), (l.shape[0], _STAT_W))
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc, *, scale, causal, block_q, block_k):
+def _bwd_dq_kernel(*refs, scale, causal, segmented, block_q, block_k):
+    if segmented:
+        q_ref, k_ref, v_ref, sq_ref, sk_ref, do_ref, lse_ref, delta_ref, dq_ref, acc = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc = refs
+        sq_ref = sk_ref = None
     iq, ik = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -98,6 +121,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc,
         ) * scale
         if causal:
             s = _causal_mask(s, iq, ik, block_q, block_k)
+        if segmented:
+            s = _segment_mask(s, sq_ref, sk_ref)
         p = jnp.exp(s - lse_ref[0][:, :1])
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0],
@@ -123,7 +148,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc,
         dq_ref[0] = acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, block_q, block_k):
+def _bwd_dkv_kernel(*refs, scale, causal, segmented, block_q, block_k):
+    if segmented:
+        (q_ref, k_ref, v_ref, sq_ref, sk_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        sq_ref = sk_ref = None
     ik, iq = pl.program_id(1), pl.program_id(2)  # note: kv outer, q inner
 
     @pl.when(iq == 0)
@@ -139,6 +171,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         ) * scale
         if causal:
             s = _causal_mask(s, iq, ik, block_q, block_k)
+        if segmented:
+            s = _segment_mask(s, sq_ref, sk_ref)
         p = jnp.exp(s - lse_ref[0][:, :1])  # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0],
@@ -185,6 +219,24 @@ def _row_specs(block_rows, outer_fixed=True):
     return pl.BlockSpec((1, block_rows, _STAT_W), lambda b, i, j: (b, j, 0))
 
 
+def _seg_inputs(seg, bh, l_q, l_k):
+    """Segment-id operands for the kernels: query ids broadcast onto the
+    [BH, L, _STAT_W] row-statistics layout, key ids pre-transposed to
+    [BH, _STAT_W, L] so a kv block is a directly-loadable row vector."""
+    seg = seg.astype(jnp.int32)
+    seg_q = jnp.broadcast_to(seg[:, :, None], (bh, l_q, _STAT_W))
+    seg_k = jnp.broadcast_to(seg[:, None, :], (bh, _STAT_W, l_k))
+    return seg_q, seg_k
+
+
+def _seg_k_spec(block_k, outer_fixed=False):
+    """BlockSpec over the transposed [BH, _STAT_W, L] key-segment layout;
+    the kv index comes from grid dim 2 unless ``outer_fixed``."""
+    if outer_fixed:
+        return pl.BlockSpec((1, _STAT_W, block_k), lambda b, i, j: (b, 0, i))
+    return pl.BlockSpec((1, _STAT_W, block_k), lambda b, i, j: (b, 0, j))
+
+
 def _pick_block(seq, preferred):
     """Largest power-of-two block ≤ preferred that divides seq (whole-array
     block for short sequences); pallas pads ragged trailing blocks with
@@ -202,23 +254,31 @@ def _pick_block(seq, preferred):
     )
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, seg, scale, causal, block_q, block_k, interpret):
     bh, l_q, d = q.shape
     l_k = k.shape[1]
     block_q = _pick_block(l_q, block_q)
     block_k = _pick_block(l_k, block_k)
     grid = (bh, pl.cdiv(l_q, block_q), pl.cdiv(l_k, block_k))
+    segmented = seg is not None
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+        _fwd_kernel, scale=scale, causal=causal, segmented=segmented,
+        block_q=block_q, block_k=block_k,
     )
+    in_specs = [
+        _specs(block_q, d, True),
+        _specs(block_k, d, False),
+        _specs(block_k, d, False),
+    ]
+    operands = [q, k, v]
+    if segmented:
+        seg_q, seg_k = _seg_inputs(seg, bh, l_q, l_k)
+        in_specs += [_row_specs(block_q, True), _seg_k_spec(block_k, False)]
+        operands += [seg_q, seg_k]
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            _specs(block_q, d, True),
-            _specs(block_k, d, False),
-            _specs(block_k, d, False),
-        ],
+        in_specs=in_specs,
         out_specs=[_specs(block_q, d, True), _row_specs(block_q, True)],
         out_shape=[
             jax.ShapeDtypeStruct((bh, l_q, d), q.dtype),
@@ -231,7 +291,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         compiler_params=_compiler_params(interpret),
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     return o, lse
 
 
@@ -245,47 +305,68 @@ def _compiler_params(interpret):
     )
 
 
-def _flash_bwd(q, k, v, do, o, lse, scale, causal, block_q, block_k, interpret):
+def _flash_bwd(q, k, v, seg, do, o, lse, scale, causal, block_q, block_k, interpret):
     bh, l_q, d = q.shape
     l_k = k.shape[1]
     block_q = _pick_block(l_q, block_q)
     block_k = _pick_block(l_k, block_k)
+    segmented = seg is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[:, :, None], (bh, l_q, _STAT_W))
+    if segmented:
+        seg_q, seg_k = _seg_inputs(seg, bh, l_q, l_k)
 
+    dq_in_specs = [
+        _specs(block_q, d, True),
+        _specs(block_k, d, False),
+        _specs(block_k, d, False),
+    ]
+    dq_operands = [q, k, v]
+    if segmented:
+        dq_in_specs += [_row_specs(block_q, True), _seg_k_spec(block_k, False)]
+        dq_operands += [seg_q, seg_k]
+    dq_in_specs += [
+        _specs(block_q, d, True),
+        _row_specs(block_q, True),
+        _row_specs(block_q, True),
+    ]
+    dq_operands += [do, lse, delta]
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+            _bwd_dq_kernel, scale=scale, causal=causal, segmented=segmented,
+            block_q=block_q, block_k=block_k,
         ),
         grid=(bh, pl.cdiv(l_q, block_q), pl.cdiv(l_k, block_k)),
-        in_specs=[
-            _specs(block_q, d, True),
-            _specs(block_k, d, False),
-            _specs(block_k, d, False),
-            _specs(block_q, d, True),
-            _row_specs(block_q, True),
-            _row_specs(block_q, True),
-        ],
+        in_specs=dq_in_specs,
         out_specs=_specs(block_q, d, True),
         out_shape=jax.ShapeDtypeStruct((bh, l_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_compiler_params(interpret),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_operands)
 
+    dkv_in_specs = [
+        _specs(block_q, d, False),  # q indexed by inner grid dim
+        _specs(block_k, d, True),  # k fixed per outer step
+        _specs(block_k, d, True),
+    ]
+    dkv_operands = [q, k, v]
+    if segmented:
+        dkv_in_specs += [_row_specs(block_q, False), _seg_k_spec(block_k, True)]
+        dkv_operands += [seg_q, seg_k]
+    dkv_in_specs += [
+        _specs(block_q, d, False),
+        _row_specs(block_q, False),
+        _row_specs(block_q, False),
+    ]
+    dkv_operands += [do, lse, delta]
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+            _bwd_dkv_kernel, scale=scale, causal=causal, segmented=segmented,
+            block_q=block_q, block_k=block_k,
         ),
         grid=(bh, pl.cdiv(l_k, block_k), pl.cdiv(l_q, block_q)),
-        in_specs=[
-            _specs(block_q, d, False),  # q indexed by inner grid dim
-            _specs(block_k, d, True),  # k fixed per outer step
-            _specs(block_k, d, True),
-            _specs(block_q, d, False),
-            _row_specs(block_q, False),
-            _row_specs(block_q, False),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[_specs(block_k, d, True), _specs(block_k, d, True)],
         out_shape=[
             jax.ShapeDtypeStruct((bh, l_k, d), k.dtype),
@@ -297,31 +378,35 @@ def _flash_bwd(q, k, v, do, o, lse, scale, causal, block_q, block_k, interpret):
         ],
         compiler_params=_compiler_params(interpret),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_operands)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_bhld(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention_bhld(q, k, v, seg, scale, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, seg, scale, causal, block_q, block_k, interpret)
     return o
 
 
-def _flash_attention_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse)
+def _flash_attention_fwd(q, k, v, seg, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, seg, scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, seg, o, lse)
 
 
 def _flash_attention_bwd(scale, causal, block_q, block_k, interpret, res, do):
-    q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, do, o, lse, scale, causal, block_q, block_k, interpret)
+    q, k, v, seg, o, lse = res
+    dq, dk, dv = _flash_bwd(
+        q, k, v, seg, do, o, lse, scale, causal, block_q, block_k, interpret
+    )
+    # integer segment ids carry no gradient (None = zero cotangent)
+    return dq, dk, dv, None
 
 
 _flash_attention_bhld.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
 def flash_attention(
-    q, k, v, causal=False, scale=None,
+    q, k, v, causal=False, scale=None, segment_ids=None,
     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K, interpret=False,
 ):
     """Flash attention over ``[batch, heads, seq, head_dim]`` arrays.
@@ -330,13 +415,23 @@ def flash_attention(
     :func:`tensorflowonspark_tpu.parallel.ring_attention.plain_attention`
     with O(L·D) memory. Sequence lengths must divide into the block sizes
     (pad upstream; the transformer pads its own inputs).
+
+    ``segment_ids`` (``int32 [batch, seq]``, 0 = padding) fences packed
+    sequences: scores between positions with different ids are masked, so
+    pack neighbours never cross-attend (the text plane's block-diagonal
+    contract). Ids are shared across heads and carry no gradient.
     """
     b, h, l_q, d = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     merge = lambda t: t.reshape(b * h, t.shape[2], d)  # noqa: E731
+    seg = None
+    if segment_ids is not None:
+        seg = jnp.broadcast_to(
+            segment_ids.astype(jnp.int32)[:, None, :], (b, h, l_q)
+        ).reshape(b * h, l_q)
     o = _flash_attention_bhld(
-        merge(q), merge(k), merge(v), float(scale), bool(causal),
+        merge(q), merge(k), merge(v), seg, float(scale), bool(causal),
         int(block_q), int(block_k), bool(interpret),
     )
     return o.reshape(b, h, l_q, d)

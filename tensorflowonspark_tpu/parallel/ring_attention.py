@@ -26,12 +26,18 @@ from jax import lax
 _NEG_BIG = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def _block_attn(q_scaled, k, v, o, m, l, q_pos, k_pos, causal):
+def _block_attn(q_scaled, k, v, o, m, l, q_pos, k_pos, causal, q_seg=None, k_seg=None):
     """One flash-style accumulation step: fold a K/V block into (o, m, l)."""
     scores = jnp.einsum("bhqd,bhkd->bhqk", q_scaled, k.astype(jnp.float32))
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]
         scores = jnp.where(mask[None, None], scores, _NEG_BIG)
+    if q_seg is not None:
+        # packed-sequence fence: a query only sees keys of its own segment
+        # (ids are global, so the mask is exact no matter which ring hop
+        # this K/V block came from)
+        seg_mask = q_seg[:, None, :, None] == k_seg[:, None, None, :]
+        scores = jnp.where(seg_mask, scores, _NEG_BIG)
     m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
     corr = jnp.exp(m - m_new)
     p = jnp.exp(scores - m_new)
@@ -40,7 +46,7 @@ def _block_attn(q_scaled, k, v, o, m, l, q_pos, k_pos, causal):
     return o_new, m_new, l_new
 
 
-def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+def ring_attention(q, k, v, axis_name, causal=False, scale=None, segment_ids=None):
     """Blockwise ring attention; call inside ``shard_map`` over ``axis_name``.
 
     ``q``/``k``/``v``: the *local* sequence block, ``[batch, heads, seq_local,
@@ -48,6 +54,11 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     is accumulated online, then K/V rotate one hop (member i → i+1). Global
     causal masking uses each block's origin index, so the result is exactly
     standard causal attention on the concatenated sequence.
+
+    ``segment_ids`` (``int32 [batch, seq_local]``, 0 = padding) is this
+    member's local block of packed-sequence ids; the key-side ids rotate
+    around the ring alongside K/V, so cross-segment scores are masked on
+    every hop and packed sequences never cross-attend.
     """
     from tensorflowonspark_tpu.parallel.collectives import axis_size
 
@@ -69,26 +80,51 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     m0 = jnp.full(q.shape[:3] + (1,), _NEG_BIG, jnp.float32) + zero_qv
     l0 = jnp.zeros(q.shape[:3] + (1,), jnp.float32) + zero_qv
 
-    def step(carry, s):
-        o, m, l, k_cur, v_cur = carry
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    if segment_ids is None:
+
+        def step(carry, s):
+            o, m, l, k_cur, v_cur = carry
+            src = (my - s) % n  # whose block we hold after s rotations
+            k_pos = src * l_k + jnp.arange(l_k)
+            o, m, l = _block_attn(q_scaled, k_cur, v_cur, o, m, l, q_pos, k_pos, causal)
+            k_cur = lax.ppermute(k_cur, axis_name, perm=perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm=perm)
+            return (o, m, l, k_cur, v_cur), None
+
+        (o, _, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+        return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+    q_seg = segment_ids.astype(jnp.int32)
+
+    def seg_step(carry, s):
+        o, m, l, k_cur, v_cur, k_seg_cur = carry
         src = (my - s) % n  # whose block we hold after s rotations
         k_pos = src * l_k + jnp.arange(l_k)
-        o, m, l = _block_attn(q_scaled, k_cur, v_cur, o, m, l, q_pos, k_pos, causal)
-        perm = [(i, (i + 1) % n) for i in range(n)]
+        o, m, l = _block_attn(
+            q_scaled, k_cur, v_cur, o, m, l, q_pos, k_pos, causal,
+            q_seg=q_seg, k_seg=k_seg_cur,
+        )
         k_cur = lax.ppermute(k_cur, axis_name, perm=perm)
         v_cur = lax.ppermute(v_cur, axis_name, perm=perm)
-        return (o, m, l, k_cur, v_cur), None
+        k_seg_cur = lax.ppermute(k_seg_cur, axis_name, perm=perm)
+        return (o, m, l, k_cur, v_cur, k_seg_cur), None
 
-    (o, _, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    (o, _, l, _, _, _), _ = lax.scan(seg_step, (o0, m0, l0, k, v, q_seg), jnp.arange(n))
     return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, mesh, causal=False, scale=None, axis="sp"):
+def ring_attention_sharded(
+    q, k, v, mesh, causal=False, scale=None, axis="sp", segment_ids=None
+):
     """Apply ring attention to globally-shaped ``[B, H, L, D]`` arrays, with
     the sequence dim sharded over ``axis`` and batch over the data axes.
 
     Falls back to plain (single-block) attention when the mesh has no ``axis``
-    axis — same math, no ring.
+    axis — same math, no ring. ``segment_ids`` (``int32 [B, L]``, 0 =
+    padding) fences packed sequences; it is sharded over ``axis`` like the
+    sequence dim and rotated with K/V inside the ring.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -96,7 +132,7 @@ def ring_attention_sharded(q, k, v, mesh, causal=False, scale=None, axis="sp"):
 
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if axis not in sizes or sizes[axis] == 1:
-        return plain_attention(q, k, v, causal=causal, scale=scale)
+        return plain_attention(q, k, v, causal=causal, scale=scale, segment_ids=segment_ids)
 
     batch = data_axes(mesh)
     batch_div = 1
@@ -105,22 +141,44 @@ def ring_attention_sharded(q, k, v, mesh, causal=False, scale=None, axis="sp"):
     if q.shape[0] % batch_div or q.shape[2] % sizes[axis] or k.shape[2] % sizes[axis]:
         # shapes that don't divide the mesh (e.g. module.init on a [1, small]
         # probe batch) fall back to the single-block path — same math
-        return plain_attention(q, k, v, causal=causal, scale=scale)
+        return plain_attention(q, k, v, causal=causal, scale=scale, segment_ids=segment_ids)
     bspec = batch if len(batch) > 1 else (batch[0] if batch else None)
     spec = P(bspec, None, axis, None)
     from tensorflowonspark_tpu.parallel.collectives import shard_map
 
+    if segment_ids is None:
+        fn = shard_map(
+            functools.partial(ring_attention, axis_name=axis, causal=causal, scale=scale),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+        return fn(q, k, v)
+
+    seg_spec = P(bspec, axis)
+
+    def _seg_ring(q_l, k_l, v_l, seg_l):
+        return ring_attention(
+            q_l, k_l, v_l, axis_name=axis, causal=causal, scale=scale,
+            segment_ids=seg_l,
+        )
+
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=axis, causal=causal, scale=scale),
+        _seg_ring,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=(spec, spec, spec, seg_spec),
         out_specs=spec,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, segment_ids.astype(jnp.int32))
 
 
-def plain_attention(q, k, v, causal=False, scale=None):
-    """Reference single-device attention (the L_local == L ring case)."""
+def plain_attention(q, k, v, causal=False, scale=None, segment_ids=None):
+    """Reference single-device attention (the L_local == L ring case).
+
+    ``segment_ids`` (``int32 [B, L]``, 0 = padding) makes the mask
+    block-diagonal over packed sequences — the unpacked-equivalence oracle
+    the flash and ring variants are tested against.
+    """
     head_dim = q.shape[-1]
     if scale is None:
         scale = 1.0 / math.sqrt(head_dim)
@@ -129,5 +187,9 @@ def plain_attention(q, k, v, causal=False, scale=None):
         l_q, l_k = q.shape[2], k.shape[2]
         mask = jnp.arange(l_q)[:, None] >= jnp.arange(l_k)[None, :]
         scores = jnp.where(mask[None, None], scores, _NEG_BIG)
+    if segment_ids is not None:
+        seg = segment_ids.astype(jnp.int32)
+        seg_mask = seg[:, None, :, None] == seg[:, None, None, :]
+        scores = jnp.where(seg_mask, scores, _NEG_BIG)
     p = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
