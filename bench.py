@@ -31,6 +31,11 @@ Modes (BENCH_MODE env):
   all-reduce with collective/compute overlap; reports scaling efficiency,
   per-rank step-time p50/p99 spread, and the measured overlap fraction
   (``value``). Rank timings outside the pair-validity band are discarded.
+* ``elastic`` — measured recovery-time delta of the bidirectional ladder:
+  a warned ``node.preempt`` (SIGTERM → async-checkpoint drain → parting
+  status) vs an unwarned ``node.kill`` (SIGKILL → lease expiry) on an
+  identical once-latched 1-worker run; reports recovery gap and replayed
+  steps per leg (``vs_baseline`` = unwarned/warned recovery ratio).
 * ``mnist_epoch`` — BASELINE.json metric 2, "MNIST epoch time
   (InputMode.SPARK)": wall-clock seconds to push one epoch of MNIST-shaped
   rows through a live 1-worker cluster's feed plane (reservation server,
@@ -140,17 +145,10 @@ def seed_autotuner(tuner, per_batch_rate, packed_rate, win, batch_imgs, batch_by
     return True
 
 
-def classify_stalls(read_s, parse_s, emit_s, wait_s):
-    """Name the bottleneck the stall counters point at, so the BENCH JSON
-    says *why* a number is what it is instead of leaving four counters to
-    interpret: the producer blocking on a full prefetch queue at least as
-    long as the consumer starved means the consumer (device) is the gate
-    (``device_bound``); otherwise the input path is, split by which
-    producer stage dominated — ``decode_bound`` when parse time beats shard
-    IO, ``io_bound`` when reads do."""
-    if emit_s >= wait_s:
-        return "device_bound"
-    return "decode_bound" if parse_s >= read_s else "io_bound"
+# the stall classification now lives in the shared control core (the
+# cluster scaler and the per-process autotuners reason from it too); the
+# bench keeps its historical name as a re-export
+from tensorflowonspark_tpu.control import classify_stalls  # noqa: E402,F401
 
 
 def feed_fields(tuner, window_k, batch_bytes):
@@ -1327,6 +1325,198 @@ def bench_ckpt(tiny):
     }
 
 
+def _elastic_bench_fun(args, ctx):
+    """One life of the recovery-delta workload: resume from the newest
+    checkpoint, log a timestamped line per step, save async every step (the
+    engine supersedes, so the pending snapshot is always the newest step —
+    exactly what a preemption drain lands and an unwarned SIGKILL loses)."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import ckpt, parallel, resilience
+    from tensorflowonspark_tpu.ckpt.reshard import reshard_restore
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.train import SyncDataParallel, checkpoint
+
+    strategy = SyncDataParallel(
+        parallel.local_mesh({"dp": 1, "fsdp": -1}), fsdp=True, min_weight_size=1
+    )
+    # a state big enough that one durable commit outlasts one step: the
+    # writer runs a few steps behind the loop, which is exactly the window
+    # an unwarned SIGKILL loses (and a warned drain saves)
+    model = mnist.create_model("mlp", hidden=args["hidden"])
+    optimizer = optax.sgd(0.1)
+    state = strategy.create_state(
+        mnist.make_init_fn(model), optimizer, jax.random.PRNGKey(0)
+    )
+    step = strategy.compile_train_step(
+        mnist.make_loss_fn(model), optimizer, has_aux=True, donate=False
+    )
+    rng = np.random.default_rng(3)
+    batch = strategy.shard_batch(
+        {
+            "image": rng.standard_normal((16, 28, 28)).astype(np.float32),
+            "label": rng.integers(0, 10, 16),
+        }
+    )
+    resumed_from = 0
+    latest = checkpoint.latest_checkpoint(args["model_dir"])
+    if latest:
+        state = reshard_restore(latest, strategy=strategy, target=state)
+        resumed_from = int(jax.device_get(state.step))
+    global_step = resumed_from
+    with open(args["log"], "a") as lf:
+        lf.write("start {:.6f} {}\n".format(time.time(), resumed_from))
+    with ckpt.AsyncCheckpointEngine(args["model_dir"]) as eng:
+        # flat Backoff schedule as the step pacer: each step stays faster
+        # than a durable commit, so the writer is always a few steps behind
+        pacer = resilience.Backoff(
+            base=args["step_pace_secs"], factor=1.0, jitter=0.0
+        )
+        for _ in pacer.attempts():
+            if global_step >= args["target_steps"]:
+                break
+            state, metrics = step(state, batch)
+            jax.block_until_ready(metrics["loss"])
+            global_step += 1
+            eng.save(state, global_step)
+            with open(args["log"], "a") as lf:
+                lf.write("step {:.6f} {}\n".format(time.time(), global_step))
+        if not eng.drain(timeout=120):
+            raise RuntimeError("final checkpoint drain timed out")
+
+
+def _parse_elastic_lives(path):
+    """The per-step log as lives: each ``start`` line opens one, carrying
+    every (t, step) sample so the caller can find the catch-up point."""
+    lives = []
+    with open(path) as f:
+        for line in f:
+            kind, t, v = line.split()
+            t, v = float(t), int(v)
+            if kind == "start":
+                lives.append(
+                    {"start_t": t, "resumed_from": v, "last_t": t,
+                     "last_step": v, "samples": [(t, v)]}
+                )
+            elif lives:
+                lives[-1]["last_t"] = t
+                lives[-1]["last_step"] = v
+                lives[-1]["samples"].append((t, v))
+    return lives
+
+
+def _elastic_recovery_secs(lives):
+    """Seconds from the last pre-fault step to the moment the next life
+    *regained that training position* — detection + relaunch + restore +
+    every replayed step. Replay is part of recovery: an unwarned kill must
+    retrain the steps its newest committed checkpoint predates, a warned
+    drain resumes exactly where it stopped."""
+    fault_t, fault_step = lives[0]["last_t"], lives[0]["last_step"]
+    for t, s in lives[1]["samples"]:
+        if s >= fault_step:
+            return t - fault_t
+    return lives[1]["last_t"] - fault_t
+
+
+def bench_elastic(tiny):
+    """``BENCH_MODE=elastic`` — measured recovery-time delta, warned vs
+    unwarned. Two identical 1-worker ladder runs, each hit once (latched)
+    mid-training: the **unwarned** leg SIGKILLs the child (``node.kill`` —
+    detection waits out the lease TTL, progress since the last *committed*
+    checkpoint is replayed), the **warned** leg SIGTERMs it
+    (``node.preempt`` — the handler drains the pending snapshot and commits
+    a ``preempted`` parting status, so nothing is replayed). The model is
+    sized so one durable commit outlasts one step: the async writer runs a
+    few steps behind the loop, and that lag is exactly what the kill loses
+    and the drain saves. ``value`` is the warned recovery gap — seconds
+    from the last pre-fault step until the next life *regained that
+    training position* (detection + relaunch + restore + every replayed
+    step); ``vs_baseline`` the unwarned/warned ratio. Both gaps include
+    the identical relaunch cost (reservation + jax init + restore), so
+    the delta isolates what the warning buys."""
+    import shutil
+    import sys
+    import tempfile
+
+    from tensorflowonspark_tpu import chaos, elastic
+    from tensorflowonspark_tpu.TFCluster import InputMode
+    from tensorflowonspark_tpu.backends.local import LocalSparkContext
+
+    os.environ.setdefault("TOS_HEARTBEAT_INTERVAL", "0.2")
+    os.environ.setdefault("TOS_MONITOR_INTERVAL", "0.5")
+    os.environ.setdefault("TOS_HEARTBEAT_STALE", "4")
+    target_steps = int(os.environ.get("BENCH_ELASTIC_STEPS", "60"))
+    hidden = 1024 if tiny else 8192
+    pace = 0.1
+    after_beats = 15  # the fault lands ~3s in: mid-training by construction
+    legs = {}
+    tmp = tempfile.mkdtemp(prefix="bench_elastic_")
+    try:
+        for label, site in (("unwarned", "node.kill"), ("warned", "node.preempt")):
+            leg_dir = os.path.join(tmp, label)
+            model_dir = os.path.join(leg_dir, "model")
+            os.makedirs(model_dir)
+            log = os.path.join(leg_dir, "steps.log")
+            plan = chaos.ChaosPlan(seed=5).site(
+                site, probability=1.0, max_count=1, victim=0,
+                after_beats=after_beats,
+                once_path=os.path.join(leg_dir, "fault.latch"),
+            )
+            chaos.install(plan)
+            sc = LocalSparkContext(num_executors=1, task_timeout=900)
+            t0 = time.perf_counter()
+            try:
+                result = elastic.run_ladder(
+                    sc, _elastic_bench_fun,
+                    {"model_dir": model_dir, "log": log, "hidden": hidden,
+                     "target_steps": target_steps, "step_pace_secs": pace},
+                    num_executors=1, max_relaunches=2, blacklist_after=2,
+                    preflight=False, input_mode=InputMode.TENSORFLOW,
+                    master_node=None, env={"JAX_PLATFORMS": "cpu"},
+                    jax_distributed=False, reservation_timeout=120,
+                    shutdown_timeout=240,
+                )
+            finally:
+                wall = time.perf_counter() - t0
+                sc.stop()
+                chaos.uninstall()
+            lives = _parse_elastic_lives(log)
+            if len(lives) != 2 or result.relaunches != 1:
+                raise RuntimeError(
+                    "{} leg took {} live(s) / {} relaunch(es); the fault "
+                    "must land exactly once mid-training".format(
+                        label, len(lives), result.relaunches
+                    )
+                )
+            legs[label] = {
+                "recovery_secs": round(_elastic_recovery_secs(lives), 2),
+                "replayed_steps": lives[0]["last_step"] - lives[1]["resumed_from"],
+                "steps_before_fault": lives[0]["last_step"],
+                "total_wall_secs": round(wall, 1),
+            }
+            print("elastic {} leg: {}".format(label, legs[label]), file=sys.stderr)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    warned, unwarned = legs["warned"], legs["unwarned"]
+    return {
+        "metric": "elastic_recovery_seconds",
+        "value": warned["recovery_secs"],
+        "unit": "seconds from last pre-fault step to regaining it "
+                "(warned node.preempt drain; unwarned node.kill leg {}s, "
+                "replayed {} vs {} step(s))".format(
+                    unwarned["recovery_secs"], unwarned["replayed_steps"],
+                    warned["replayed_steps"],
+                ),
+        "vs_baseline": round(
+            unwarned["recovery_secs"] / max(warned["recovery_secs"], 1e-9), 2
+        ),
+        "unwarned": unwarned,
+        "warned": warned,
+    }
+
+
 def _multichip_member(pid, num_procs, coord_port, root_addr):
     """One rank of the multichip weak-scaling world: joins the gloo world,
     forms the host all-reduce group, and runs the bucketed-overlap step
@@ -1693,7 +1883,9 @@ def main():
     # feed -> fused train loop), per VERDICT r2: synthetic-data numbers skip
     # the part of the system most likely to be the bottleneck
     mode = os.environ.get("BENCH_MODE", "resnet_real")
-    _force_platform_for_tiny(tiny or mode in ("mnist_epoch", "feed_plane", "ckpt", "decode"))
+    _force_platform_for_tiny(
+        tiny or mode in ("mnist_epoch", "feed_plane", "ckpt", "decode", "elastic")
+    )
     if mode == "mnist_epoch":
         result = bench_mnist_epoch()
     elif mode == "feed_plane":
@@ -1702,6 +1894,8 @@ def main():
         result = bench_decode(tiny)
     elif mode == "ckpt":
         result = bench_ckpt(tiny)
+    elif mode == "elastic":
+        result = bench_elastic(tiny)
     elif mode == "lm":
         result = bench_lm(tiny)
     elif mode == "serving":

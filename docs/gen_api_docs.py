@@ -42,6 +42,9 @@ MODULES = [
     "tensorflowonspark_tpu.compat",
     "tensorflowonspark_tpu.util",
     "tensorflowonspark_tpu.resilience",
+    "tensorflowonspark_tpu.control",
+    "tensorflowonspark_tpu.control.core",
+    "tensorflowonspark_tpu.control.scaler",
     "tensorflowonspark_tpu.chaos",
     "tensorflowonspark_tpu.obs",
     "tensorflowonspark_tpu.obs.registry",

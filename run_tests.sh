@@ -156,25 +156,30 @@ if [[ "$TRACE_SMOKE" == "1" ]]; then
 fi
 
 if [[ "$CHAOS" == "1" ]]; then
-  # node.kill leg (first, before the benign env plan is exported — the test
-  # installs its own single-victim plan): the recovery ladder under a
-  # deterministic victim kill — blacklist after repeated loss, shrink-to-fit
-  # relaunch, resharded resume, recovery counters asserted from the merged
-  # cluster metrics.
+  # recovery-ladder legs (first, before the benign env plan is exported —
+  # each test installs its own single-victim plan): node.kill drives the
+  # shrink direction (blacklist after repeated loss, shrink-to-fit
+  # relaunch, resharded resume), and the once-latched preempt→drain→regrow
+  # run drives the grow direction (mid-run regrow poll re-probes the
+  # recovered victim, posts a preemption warning, the drained workers part
+  # cleanly and the ladder relaunches at full size) — recovery counters
+  # asserted from the merged cluster metrics in both.
   #
-  # The kill leg and the watchdog lease-expiry leg record into one flight
-  # root on one pinned trace id (tracing.mint adopts TOS_TRACE_ID), so the
-  # victim child's last spans, the watchdog's lease_expired verdict, and
-  # the ladder's relaunch spans land on ONE causally-ordered timeline —
-  # asserted post-hoc by tracemerge --check below.
+  # All ladder legs and the watchdog lease-expiry leg record into one
+  # flight root on one pinned trace id (tracing.mint adopts TOS_TRACE_ID),
+  # so the victim child's last spans, the watchdog's lease_expired verdict,
+  # the regrow poll's elastic_regrow span, the children's preempt_drain
+  # events, and the ladder's relaunch spans land on ONE causally-ordered
+  # timeline — asserted post-hoc by tracemerge --check below.
   export TOS_TRACE_DIR="$(mktemp -d /tmp/tos_trace_chaos.XXXXXX)"
   export TOS_TRACE_ID="$(python -c 'import secrets; print(secrets.token_hex(16))')"
-  echo "chaos leg: node.kill recovery-ladder run (flight recording at $TOS_TRACE_DIR)"
+  echo "chaos leg: recovery-ladder runs: node.kill shrink + preempt-drain regrow (flight recording at $TOS_TRACE_DIR)"
   python -m pytest tests/test_elastic.py -q -m "chaos and slow"
   echo "chaos leg: watchdog lease-expiry run (same trace id)"
   python -m pytest "tests/test_watchdog.py::test_lease_expiry_names_the_executor_for_the_ledger" -q
   python -m tensorflowonspark_tpu.obs.tracemerge --dir "$TOS_TRACE_DIR" --check \
     --require-span node_main --require-span elastic_relaunch \
+    --require-span elastic_regrow --require-event preempt_drain \
     --require-event lease_expired --require-same-trace
   echo "chaos leg: flight recording merged clean ($TOS_TRACE_DIR/trace.json)"
   unset TOS_TRACE_DIR TOS_TRACE_ID

@@ -177,6 +177,21 @@ class TFCluster:
                 return "node {}:{} failed:\n{}".format(row["job_name"], row["task_index"], tb)
             return None
 
+        def _preempted_problem(eid):
+            """A child committed a ``preempted`` parting status: its durable
+            ``leave`` above IS the lease handoff; the message wording (the
+            word "preempted" + "(executor N)") is what
+            ``elastic.classify_failure`` attributes ``preemption`` events
+            from — first-class, never blacklisted, never budget-charged."""
+            row = rows_by_eid.get(eid)
+            job, task = (
+                (row["job_name"], row["task_index"]) if row else ("worker", "?")
+            )
+            obs_tracing.event(
+                "node_preempted", executor=eid, job=job, task_index=task
+            )
+            return "node {}:{} preempted (executor {})".format(job, task, eid)
+
         def _poll_direct(eid):
             """Direct channel poll: error → status(leave) → beat(renew)."""
             problem = _node_error(eid)
@@ -186,6 +201,8 @@ class TFCluster:
             status = mgr.get("child_status")
             if status is not None:
                 self.registry.leave(eid, reason=str(status))
+                if str(status) == "preempted":
+                    return _preempted_problem(eid)
                 return None
             self.registry.renew(eid, beat=mgr.get("heartbeat"))
             return None
@@ -227,6 +244,8 @@ class TFCluster:
                     continue
                 covered.add(eid)
                 self.registry.leave(eid, reason=str(status))
+                if str(status) == "preempted":
+                    problems[eid] = _preempted_problem(eid)
             for eid, beat in beats.items():
                 if eid in problems:
                     continue
@@ -654,6 +673,44 @@ class TFCluster:
             self._monitor_stop.set()
         logger.info("cluster aborted: %s", reason)
 
+    def preempt(self, reason="preempted by driver", workers=None):
+        """Post a preemption *warning* on worker channels — the
+        driver-initiated sibling of a platform SIGTERM grace window.
+
+        Each jax child's heartbeat notices the ``preempt`` key within one
+        beat and runs its warned-shutdown path: drain in-flight async
+        checkpoints, flush metrics, commit a ``preempted`` parting status
+        (which the watchdog turns into a durable registry ``leave``), and
+        exit clean. Unlike :meth:`abort` this is a *handoff*, not a
+        teardown: the recovery ladder classifies the resulting loss as a
+        first-class ``preemption`` (no blacklist, no restart-budget charge)
+        and relaunches — the regrow path uses exactly this to restart onto
+        a larger mesh without losing the step in flight.
+
+        ``workers`` restricts the warning to specific executor ids.
+        Returns the executor ids the warning reached.
+        """
+        posted = []
+        for row in _worker_rows(self._current_rows()):
+            if workers is not None and row["executor_id"] not in workers:
+                continue
+            try:
+                mgr = TFManager.connect(
+                    tuple(row["manager_addr"]), self.cluster_meta["authkey"]
+                )
+                mgr.set("preempt", str(reason))
+                posted.append(row["executor_id"])
+            except Exception as e:
+                logger.warning(
+                    "preempt: could not reach %s:%s: %s",
+                    row["job_name"], row["task_index"], e,
+                )
+        if posted:
+            logger.info(
+                "preemption warning posted to executors %s: %s", posted, reason
+            )
+        return posted
+
     def wait_for_completion(self, poll_secs=1.0, timeout=None):
         """Block until every worker node retires (channel state ``"stopped"``)
         or a failure is recorded in ``tf_status`` (InputMode.TENSORFLOW).
@@ -969,7 +1026,7 @@ def run(
             ttl=float(os.environ.get("TOS_HEARTBEAT_STALE", "30")),
             journal_dir=registry_dir,
         )
-    registry.begin_generation(template)
+    registry.begin_generation(template, target_size=num_executors)
     for eid in blacklist or ():
         # one membership truth: the caller's static blacklist is mirrored
         # into (and journaled by) the registry

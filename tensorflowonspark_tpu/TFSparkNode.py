@@ -241,6 +241,9 @@ def _child_entry(fn, tf_args, ctx, cluster_meta, error_queue_spec):
         # the long-lived child owns this executor's obs_snapshot lane: its
         # cumulative registry is overwritten on the channel every interval
         publisher = obs_aggregate.SnapshotPublisher(ctx.mgr).start()
+        # from here a preemption warning (SIGTERM, driver preempt key, or
+        # the node.preempt chaos site) drains instead of dying abruptly
+        _arm_preemption(ctx.mgr, ctx, publisher)
         if cluster_meta.get("jax_distributed", True):
             ctx.initialize_distributed()
         try:
@@ -311,8 +314,9 @@ def _drain_checkpoints():
 
         if not ckpt.drain_all(timeout=CHECKPOINT_DRAIN_TIMEOUT):
             logger.warning(
-                "async checkpoint drain timed out after %ss on child exit",
+                "async checkpoint drain timed out after %ss on child exit: %s",
                 CHECKPOINT_DRAIN_TIMEOUT,
+                "; ".join(ckpt.busy_descriptions()) or "engine list changed",
             )
     except Exception:
         logger.exception("async checkpoint drain failed on child exit")
@@ -322,6 +326,90 @@ def _drain_checkpoints():
 #: monitor flags a node whose beat stops without a final child_status —
 #: e.g. a SIGKILLed jax child that could post no traceback)
 HEARTBEAT_INTERVAL = float(os.environ.get("TOS_HEARTBEAT_INTERVAL", "2"))
+
+
+# -- preemption-aware drain ---------------------------------------------------
+#
+# A preemption *warning* (the platform's SIGTERM grace window, the
+# ``node.preempt`` chaos site, or the driver posting ``preempt`` on the
+# channel for a regrow restart) reaches the jax child while it can still
+# act. The warned path turns an abrupt kill into a clean handoff: land every
+# in-flight async checkpoint, flush this node's metrics, commit a
+# ``preempted`` parting status on the channel (the driver's watchdog turns
+# that into a durable registry ``leave``), and exit before the kill lands.
+# The recovery ladder classifies the resulting loss as a first-class
+# ``preemption``: no blacklist entry, no restart-budget charge.
+
+_preempt_lock = threading.Lock()
+_preempt = {
+    "fired": False, "mgr": None, "publisher": None,
+    "executor_id": None, "job_name": None, "task_index": None,
+}
+
+
+def _arm_preemption(mgr, ctx, publisher):
+    """Hand the warned-shutdown path its channel/publisher handles and
+    install the real SIGTERM handler (jax-child main thread only)."""
+    with _preempt_lock:
+        _preempt.update(
+            mgr=mgr, publisher=publisher, executor_id=ctx.executor_id,
+            job_name=ctx.job_name, task_index=ctx.task_index,
+        )
+    try:
+        signal.signal(
+            signal.SIGTERM, lambda signum, frame: _preempt_drain("sigterm")
+        )
+    except (ValueError, OSError):  # not the main thread / exotic platform
+        pass
+
+
+def _preempt_drain(source):
+    """Drain and exit under a preemption warning; never returns once it wins
+    the once-race (``os._exit`` — unwinding the training stack could
+    overwrite the parting status with a spurious ``failed``)."""
+    with _preempt_lock:
+        if _preempt["fired"]:
+            return  # handler/heartbeat race: first caller owns the exit
+        _preempt["fired"] = True
+    logger.warning(
+        "preemption warning (%s): draining checkpoints before the kill lands",
+        source,
+    )
+    try:
+        obs_tracing.event(
+            "preempt_drain", source=source,
+            executor_id=_preempt["executor_id"], job=_preempt["job_name"],
+            task_index=_preempt["task_index"],
+        )
+    except Exception:
+        pass
+    _drain_checkpoints()
+    if _preempt["publisher"] is not None:
+        try:  # flush so the drained node's metrics survive it
+            _preempt["publisher"].stop()
+        except Exception:
+            pass
+    if _preempt["mgr"] is not None:
+        try:  # the parting commit the watchdog journals as a durable leave
+            _preempt["mgr"].set("child_status", "preempted")
+        except Exception:
+            pass
+    try:
+        obs_flight.dump("preempted:{}".format(source))
+    except Exception:
+        pass
+    os._exit(143)  # 128 + SIGTERM: the conventional warned-termination code
+
+
+def _latch(path):
+    """Create a chaos ``once_path`` latch file; first creator wins."""
+    if not path:
+        return
+    try:
+        with open(path, "x") as f:
+            f.write(str(os.getpid()))
+    except OSError:
+        pass
 
 
 def _start_heartbeat(mgr, executor_id=None):
@@ -341,7 +429,7 @@ def _start_heartbeat(mgr, executor_id=None):
         # gate on the spec params BEFORE rolling the site, so non-victim
         # nodes and early beats consume neither budget nor counters
         p = chaos.plan()
-        for site in ("node.kill", "node.flap"):
+        for site in ("node.kill", "node.flap", "node.preempt"):
             spec = p.sites.get(site) if p else None
             if spec is None:
                 continue
@@ -350,13 +438,29 @@ def _start_heartbeat(mgr, executor_id=None):
                 continue
             if beat < spec.get("after_beats", 0):
                 continue
+            once = spec.get("once_path")
+            if once and os.path.exists(once):
+                # cross-process one-shot latch: each spawned child re-installs
+                # the plan with a fresh budget, so without the latch a victim
+                # respawned by the recovery ladder would die on every life
+                continue
             if site == "node.kill":
                 if chaos.fire("node.kill"):
+                    _latch(once)
                     logger.warning("chaos: node.kill — SIGKILLing executor %s child",
                                    executor_id)
                     os.kill(os.getpid(), signal.SIGKILL)
+            elif site == "node.preempt":
+                if chaos.fire("node.preempt"):
+                    _latch(once)
+                    logger.warning(
+                        "chaos: node.preempt — SIGTERMing executor %s child "
+                        "(warned shutdown)", executor_id,
+                    )
+                    os.kill(os.getpid(), signal.SIGTERM)
             else:
-                chaos.delay("node.flap")  # paused beats: watchdog sees a gap
+                if chaos.delay("node.flap"):  # paused beats: watchdog gap
+                    _latch(once)
 
     def _beat():
         failures = 0
@@ -373,6 +477,10 @@ def _start_heartbeat(mgr, executor_id=None):
                 _chaos_node_fault(n)
             try:
                 mgr.set("heartbeat", n)
+                if mgr.get("preempt") is not None:
+                    # the driver warned us (regrow restart / planned drain):
+                    # same clean-handoff path as a platform SIGTERM
+                    _preempt_drain("driver")
                 failures = 0
             except Exception:
                 # transient proxy hiccups must not kill the beat (the
@@ -596,6 +704,16 @@ class _NodeLaunchTask:
                         job_name, task_index, mgr.get("abort"),
                     )
                     return []
+                if mgr.get("child_status") == "preempted":
+                    # warned shutdown: the child drained and committed its
+                    # parting status before exiting — surface a first-class
+                    # preemption so the ladder skips the blacklist and the
+                    # restart budget (see elastic.classify_failure)
+                    raise RuntimeError(
+                        "node {}:{} preempted (executor {})".format(
+                            job_name, task_index, executor_id
+                        )
+                    )
                 err = None
                 try:
                     eq = mgr.get_queue("error")

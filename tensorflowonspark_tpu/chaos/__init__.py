@@ -98,6 +98,19 @@ site                            effect at the injection point
 ``node.flap``                   heartbeat loop stalls ``delay_s`` (``victim``,
                                 ``after_beats`` as above) — a transient loss
                                 that should NOT lead to a blacklist
+``node.preempt``                jax child SIGTERMs itself from the heartbeat
+                                loop (``victim``/``after_beats`` as above) —
+                                a preemption *warning*, not a kill: the
+                                child's real SIGTERM handler drains async
+                                checkpoints, commits a ``preempted`` parting
+                                status, and exits clean before the platform
+                                kill would land; the ladder must classify it
+                                ``preemption`` (no blacklist, no restart
+                                budget). Node sites also honor a generic
+                                ``once_path`` param: a cross-process one-shot
+                                latch file (skip when it exists, create on
+                                fire), so a victim respawned by the ladder
+                                does not die again on every life
 ``control.driver_crash``        watchdog drops the in-memory membership
                                 registry with no parting commit and recovers
                                 it from the journal under a bumped epoch —

@@ -25,6 +25,7 @@ _EXPORTS = {
     "AsyncCheckpointEngine": "engine",
     "in_flight_paths": "engine",
     "drain_all": "engine",
+    "busy_descriptions": "engine",
     "TMP_MARKER": "engine",
     "SnapshotBuffers": "snapshot",
     "HostSnapshot": "snapshot",

@@ -73,14 +73,30 @@ def in_flight_paths():
 def drain_all(timeout=None):
     """Drain every live engine (pending + in-flight saves complete).
     Called from the node runtime on child exit so a worker never abandons
-    a checkpoint it already snapshotted. Returns True when all drained."""
+    a checkpoint it already snapshotted. Returns True when all drained;
+    on timeout each stuck engine is named (checkpoint dir + pending step)
+    so the operator knows *which* resume point was abandoned."""
     with _engines_lock:
         engines = list(_engines)
     deadline = resilience.Deadline(timeout)
-    ok = True
+    stuck = []
     for engine in engines:
-        ok = engine.drain(timeout=deadline.remaining()) and ok
-    return ok
+        if not engine.drain(timeout=deadline.remaining()):
+            stuck.append(engine.pending_desc() or repr(engine))
+    if stuck:
+        logger.warning(
+            "checkpoint drain timed out (timeout=%s): %s",
+            timeout, "; ".join(stuck),
+        )
+    return not stuck
+
+
+def busy_descriptions():
+    """Human-readable descriptions of every engine with undrained work
+    (checkpoint dir + pending/committing step) — for exit-path logging."""
+    with _engines_lock:
+        engines = list(_engines)
+    return [d for d in (e.pending_desc() for e in engines) if d]
 
 
 class AsyncCheckpointEngine:
@@ -170,14 +186,40 @@ class AsyncCheckpointEngine:
 
     def drain(self, timeout=None):
         """Block until the pending and in-flight saves are fully committed
-        (or ``timeout`` elapses). Returns True when drained."""
+        (or ``timeout`` elapses). Returns True when drained; on timeout the
+        warning names this engine (:meth:`pending_desc`)."""
         deadline = resilience.Deadline(timeout)
         with self._cond:
             while self._pending is not None or self._writing:
                 if deadline.expired():
+                    logger.warning(
+                        "checkpoint drain timed out (timeout=%s): %s",
+                        timeout, self._pending_desc_locked(),
+                    )
                     return False
                 self._cond.wait(timeout=deadline.clamp(1.0))
         return True
+
+    def pending_desc(self):
+        """``"<model_dir> (pending step N, committing step M)"`` for the
+        work still undrained, or None when idle — so drain-timeout messages
+        name the engine instead of a bare boolean."""
+        with self._cond:
+            return self._pending_desc_locked()
+
+    def _pending_desc_locked(self):
+        parts = []
+        if self._pending is not None:
+            parts.append("pending step {}".format(self._pending.step))
+        if self._in_flight_path is not None:
+            parts.append("committing {}".format(
+                os.path.basename(self._in_flight_path)
+            ))
+        elif self._writing:
+            parts.append("committing")
+        if not parts:
+            return None
+        return "{} ({})".format(self.model_dir, ", ".join(parts))
 
     def close(self, timeout=None):
         """Drain, stop the writer thread, and surface any writer error.
@@ -189,7 +231,8 @@ class AsyncCheckpointEngine:
         self._thread.join(timeout=5.0)
         if not drained:
             logger.warning(
-                "checkpoint engine closed before draining (timeout=%s)", timeout
+                "checkpoint engine %s closed before draining (timeout=%s)",
+                self.model_dir, timeout,
             )
         if self._last_error is not None:
             raise self._last_error

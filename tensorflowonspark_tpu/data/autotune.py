@@ -77,6 +77,7 @@ import logging
 import time
 
 from tensorflowonspark_tpu import chaos, obs
+from tensorflowonspark_tpu.control import Controller, DeltaTicker, EwmaEstimator, StallRule
 
 logger = logging.getLogger(__name__)
 
@@ -103,9 +104,11 @@ class LinkEstimator:
     """
 
     def __init__(self, alpha=0.3):
-        if not 0.0 < alpha <= 1.0:
-            raise ValueError("alpha must be in (0, 1]")
-        self.alpha = alpha
+        # one shared EWMA core (validates alpha) blending both model terms
+        # under the same weight — the seed-on-first-observation semantics
+        # live in control.EwmaEstimator now
+        self._blender = EwmaEstimator(alpha=alpha)
+        self.alpha = self._blender.alpha
         self.fixed_s = None
         self.bytes_per_sec = None
 
@@ -115,7 +118,7 @@ class LinkEstimator:
         return self.fixed_s is not None and self.bytes_per_sec is not None
 
     def _ewma(self, old, new):
-        return new if old is None else (1.0 - self.alpha) * old + self.alpha * new
+        return self._blender.blend(old, new)
 
     def observe_fixed(self, seconds):
         """Feed one timed micro-probe (payload small enough that stream time
@@ -215,7 +218,12 @@ class FeedAutotuner:
         self.estimator = LinkEstimator(alpha=alpha)
         self._clock = clock or time.perf_counter
         self._k = None
-        self._down_streak = 0
+        # the shared hysteresis core: up one bucket immediately, down one
+        # bucket after down_patience consecutive lower recommendations
+        self._ctl = Controller(
+            levels=self.buckets, down_patience=self.down_patience,
+            name="feed_window",
+        )
         self._windows_placed = 0
         # instruments created eagerly so the five feed_* metrics exist in
         # every snapshot that saw a tuner, even before the first transfer
@@ -278,16 +286,8 @@ class FeedAutotuner:
         rec = self.recommend(batch_bytes)
         if self._k is None:
             self._k = rec
-        elif rec > self._k:
-            self._k = self.buckets[self.buckets.index(self._k) + 1]
-            self._down_streak = 0
-        elif rec < self._k:
-            self._down_streak += 1
-            if self._down_streak >= self.down_patience:
-                self._k = self.buckets[self.buckets.index(self._k) - 1]
-                self._down_streak = 0
         else:
-            self._down_streak = 0
+            self._k = self._ctl.toward(self._k, rec)
         self._k_g.set(self._k)
         return self._k, self.depth(self._k)
 
@@ -412,11 +412,18 @@ class ReadaheadAutotuner:
         self.idle_ratio = float(idle_ratio)
         self.down_patience = max(1, int(down_patience))
         self.check_every = float(check_every)
-        self._clock = clock or time.monotonic
-        self._read = read_counters or self._read_obs
-        self._last_t = None
-        self._last = None
-        self._down_streak = 0
+        # the shared control core: starvation verdict, up-fast/down-slow
+        # hysteresis inside the depth bounds, and the clocked delta gate
+        self._rule = StallRule(
+            starve_ratio=self.starve_ratio, idle_ratio=self.idle_ratio
+        )
+        self._ctl = Controller(
+            lo=self.min_depth, hi=self.max_depth,
+            down_patience=self.down_patience, name="readahead",
+        )
+        self._ticker = DeltaTicker(
+            self.check_every, read_counters or self._read_obs, clock=clock
+        )
         self._depth_g = obs.gauge(
             "readahead_depth", help="shard read-ahead depth currently allowed"
         )
@@ -445,39 +452,18 @@ class ReadaheadAutotuner:
         counter deltas (no clock, no obs — the unit-testable core)."""
         if elapsed <= 0:
             return depth
-        wait_share = wait_delta / elapsed
-        if wait_share > self.starve_ratio and read_delta >= parse_delta:
-            self._down_streak = 0
-            return min(self.max_depth, depth + 1)
-        if wait_share < self.idle_ratio and depth > self.min_depth:
-            self._down_streak += 1
-            if self._down_streak >= self.down_patience:
-                self._down_streak = 0
-                return depth - 1
-            return depth
-        self._down_streak = 0
-        return depth
+        want = self._rule.want(wait_delta / elapsed, read_delta >= parse_delta)
+        return self._ctl.step(depth, want)
 
     def tick(self, depth):
         """Clocked wrapper for :meth:`decide`: reads the counters at most
         every ``check_every`` seconds; returns the new target depth, or
         None when the interval has not elapsed yet."""
-        now = self._clock()
-        if self._last_t is None:
-            self._last_t, self._last = now, self._read()
+        out = self._ticker.tick()
+        if out is None:
             return None
-        elapsed = now - self._last_t
-        if elapsed < self.check_every:
-            return None
-        read, parse, wait = self._read()
-        target = self.decide(
-            depth,
-            read - self._last[0],
-            parse - self._last[1],
-            wait - self._last[2],
-            elapsed,
-        )
-        self._last_t, self._last = now, (read, parse, wait)
+        (read_delta, parse_delta, wait_delta), elapsed = out
+        target = self.decide(depth, read_delta, parse_delta, wait_delta, elapsed)
         if target != depth:
             self._depth_g.set(int(target))
         return target

@@ -75,6 +75,7 @@ import time
 import numpy as np
 
 from tensorflowonspark_tpu import chaos, obs
+from tensorflowonspark_tpu.control import Controller, DeltaTicker, StallRule
 from tensorflowonspark_tpu.shm import SlabSegment
 
 logger = logging.getLogger(__name__)
@@ -504,11 +505,18 @@ class DecodeAutotuner:
         self.idle_ratio = float(idle_ratio)
         self.down_patience = max(1, int(down_patience))
         self.check_every = float(check_every)
-        self._clock = clock or time.monotonic
-        self._read = read_counters or self._read_obs
-        self._last_t = None
-        self._last = None
-        self._down_streak = 0
+        # the shared control core: starvation verdict, up-fast/down-slow
+        # hysteresis inside the worker bounds, and the clocked delta gate
+        self._rule = StallRule(
+            starve_ratio=self.starve_ratio, idle_ratio=self.idle_ratio
+        )
+        self._ctl = Controller(
+            lo=self.min_workers, hi=self.max_workers,
+            down_patience=self.down_patience, name="decode_workers",
+        )
+        self._ticker = DeltaTicker(
+            self.check_every, read_counters or self._read_obs, clock=clock
+        )
 
     @staticmethod
     def _read_obs():
@@ -527,33 +535,15 @@ class DecodeAutotuner:
         counter deltas (no clock, no obs — the unit-testable core)."""
         if elapsed <= 0:
             return workers
-        wait_share = wait_delta / elapsed
-        if wait_share > self.starve_ratio and parse_delta >= wait_delta:
-            self._down_streak = 0
-            return min(self.max_workers, workers + 1)
-        if wait_share < self.idle_ratio and workers > self.min_workers:
-            self._down_streak += 1
-            if self._down_streak >= self.down_patience:
-                self._down_streak = 0
-                return workers - 1
-            return workers
-        self._down_streak = 0
-        return workers
+        want = self._rule.want(wait_delta / elapsed, parse_delta >= wait_delta)
+        return self._ctl.step(workers, want)
 
     def tick(self, workers):
         """Clocked wrapper for :meth:`decide`: reads the counters at most
         every ``check_every`` seconds; returns the new target count, or
         None when the interval has not elapsed yet."""
-        now = self._clock()
-        if self._last_t is None:
-            self._last_t, self._last = now, self._read()
+        out = self._ticker.tick()
+        if out is None:
             return None
-        elapsed = now - self._last_t
-        if elapsed < self.check_every:
-            return None
-        parse, wait = self._read()
-        target = self.decide(
-            workers, parse - self._last[0], wait - self._last[1], elapsed
-        )
-        self._last_t, self._last = now, (parse, wait)
-        return target
+        (parse_delta, wait_delta), elapsed = out
+        return self.decide(workers, parse_delta, wait_delta, elapsed)

@@ -9,7 +9,8 @@ identically. This module upgrades that loop into a **recovery ladder**:
 1. **Classify** — every failed attempt becomes a :class:`FailureEvent` with a
    kind (``launch`` / ``reservation_timeout`` / ``lease_expired`` /
    ``heartbeat_loss`` / ``node_exit`` / ``node_error`` / ``feed_timeout`` /
-   ``unknown``) and, where the failure text or exception chain allows, the
+   ``preemption`` / ``unknown``) and, where the failure text or exception
+   chain allows, the
    executor ids it implicates (:func:`classify_failure`). The :class:`FailureLedger` keeps these in a
    sliding window and enforces the restart budget against the *window*, not
    all time — a cluster that fails once a week is healthy; one that fails
@@ -29,9 +30,30 @@ identically. This module upgrades that loop into a **recovery ladder**:
    blacklisted executors are re-probed at every relaunch — a checkpoint
    boundary by construction — and forgiven when they pass, growing the
    cluster back toward full size.
+4. **Regrow mid-run** — shrink-to-fit alone ratchets downward: once the
+   cluster is small, nothing restores it until the *next* failure. With
+   ``regrow_check_secs > 0`` the ladder also re-probes the condemned
+   executors *while the shrunk attempt trains*; when enough come back
+   healthy that the :class:`~tensorflowonspark_tpu.control.ClusterScaler`
+   (patience-gated, stall-classified — never steal capacity from an
+   input-bound run) votes to grow, the driver posts a **preemption
+   warning** (:meth:`TFCluster.TFCluster.preempt`). Workers drain their
+   async checkpoints, commit a ``preempted`` parting status into the
+   membership registry and exit clean — a deliberate restart at a
+   checkpoint boundary — and the ladder's normal classify → forgive →
+   relaunch machinery resumes onto the larger mesh. A ``preemption``
+   failure is *warned* downsizing, not pathology: it never blacklists and
+   never consumes the restart budget (:data:`BUDGET_EXEMPT_KINDS`). The
+   same classification covers platform preemption notices (the jax child's
+   SIGTERM handler runs the identical drain), so a preempted-then-returning
+   executor rejoins without a ledger entry. The planned size is journaled
+   per generation (``MembershipRegistry.begin_generation(target_size=…)``)
+   so the ladder's position on the shrink/regrow ladder survives a driver
+   restart.
 
 Driver-side metrics (all visible in ``TFCluster.metrics()``):
 ``recovery_attempts_total``, ``recovery_shrinks_total``,
+``recovery_regrows_total``, ``preemptions_drained_total``,
 ``recovery_seconds_total`` (wall time spent between failure detection and
 relaunch decision), and the ``executors_blacklisted`` gauge.
 """
@@ -40,7 +62,7 @@ import logging
 import re
 import time
 
-from tensorflowonspark_tpu import TFCluster, TFSparkNode, obs, reservation
+from tensorflowonspark_tpu import TFCluster, TFSparkNode, control, obs, reservation
 from tensorflowonspark_tpu import registry as membership
 from tensorflowonspark_tpu.obs import flight as obs_flight
 from tensorflowonspark_tpu.obs import tracing as obs_tracing
@@ -52,6 +74,13 @@ logger = logging.getLogger(__name__)
 LOSS_KINDS = frozenset(
     {"heartbeat_loss", "lease_expired", "node_exit", "reservation_timeout"}
 )
+
+#: failure kinds that never consume the restart budget: a *warned* loss — the
+#: node drained its checkpoints and committed a parting status before exiting
+#: — is planned downsizing (platform preemption notice, or the ladder's own
+#: regrow restart), not pathology. Only unwarned failures should be able to
+#: exhaust ``max_restarts``.
+BUDGET_EXEMPT_KINDS = frozenset({"preemption"})
 
 _NODE_RE = re.compile(r"node (\w+):(\d+)")
 _EXIT_RE = re.compile(r"failed \(exit (-?\d+)\)")
@@ -116,6 +145,13 @@ def classify_failure(exc, role_map=None):
 
     if missing or any(isinstance(c, reservation.ReservationError) for c in chain):
         return FailureEvent("reservation_timeout", executor_ids | set(missing), text)
+    if "preempted" in text:
+        # the child's preemption drain commits a ``preempted`` parting status
+        # before exiting, and the watchdog stamps it into the failure text;
+        # checked before the lease/heartbeat phrasings because a drained
+        # child's exit can surface alongside a late expiry message — the
+        # warned signal wins
+        return FailureEvent("preemption", executor_ids, text)
     if "lease expired" in text:
         # the registry watchdog's first-class expiry event; checked before
         # the legacy phrasing because its messages contain both
@@ -142,7 +178,9 @@ class FailureLedger:
 
     * ``allow_restart()`` — True while the failures inside ``window_secs``
       stay within ``max_restarts`` (the old all-time counter is the special
-      case ``window_secs=inf``).
+      case ``window_secs=inf``). *Warned* failures
+      (:data:`BUDGET_EXEMPT_KINDS`) are recorded — they still show up in
+      ``events()`` and the trace — but never consume the budget.
     * ``suspects()`` — executor ids implicated in at least
       ``blacklist_after`` *loss-kind* failures (:data:`LOSS_KINDS`) inside
       the window. One transient fault never blacklists a node; repeated
@@ -170,7 +208,11 @@ class FailureLedger:
         return self._events
 
     def failures_in_window(self):
-        return len(self._recent())
+        """Budget-relevant failures inside the window: warned kinds
+        (:data:`BUDGET_EXEMPT_KINDS`) drained cleanly and do not count."""
+        return sum(
+            1 for _, e in self._recent() if e.kind not in BUDGET_EXEMPT_KINDS
+        )
 
     def allow_restart(self):
         return self.failures_in_window() <= self.max_restarts
@@ -259,6 +301,53 @@ def preflight_executors(sc, executor_ids, extra_probe=None):
     return bad
 
 
+def _counter_value(snapshot, name):
+    return ((snapshot.get("counters") or {}).get(name) or {}).get("value", 0.0)
+
+
+def _regrow_poll(sc, cluster, scaler, blacklist, num_executors, target, extra_probe):
+    """One checkpoint-boundary regrow check while a shrunk attempt trains.
+
+    Re-probes the condemned executors; when enough come back healthy that
+    the scaler votes to grow — patience-gated, and deferred while the
+    cluster-wide stall classification says the run is input-bound (more
+    devices would only starve harder) — posts a preemption warning to the
+    running workers. They drain their async checkpoints, commit a
+    ``preempted`` parting status and exit clean, and the ladder's normal
+    classify → forgive → relaunch machinery resumes training on the larger
+    mesh. Returns True when a regrow restart was requested.
+    """
+    healthy = sorted(
+        set(blacklist) - set(preflight_executors(sc, sorted(blacklist), extra_probe))
+    )
+    desired = num_executors - (len(blacklist) - len(healthy))
+    try:
+        snapshot = cluster.metrics() or {}
+    except Exception:
+        snapshot = {}
+    classification = control.classify_stalls(
+        _counter_value(snapshot, "data_producer_read_seconds_total"),
+        _counter_value(snapshot, "data_producer_parse_seconds_total"),
+        _counter_value(snapshot, "data_producer_emit_seconds_total"),
+        _counter_value(snapshot, "data_consumer_wait_seconds_total"),
+    )
+    allowed = scaler.decide(target, desired, classification)
+    if allowed <= target:
+        return False
+    with obs.span(
+        "elastic_regrow", current=target, target=allowed,
+        healthy=healthy, classification=classification,
+    ):
+        reached = cluster.preempt(
+            "regrow to {} executor(s): {} recovered".format(allowed, healthy)
+        )
+        logger.info(
+            "regrow: preemption warning posted to executors %s (%d -> %d)",
+            reached, target, allowed,
+        )
+    return True
+
+
 class ElasticResult:
     """Outcome of a completed :func:`run_ladder` run.
 
@@ -291,6 +380,8 @@ def run_ladder(
     window_secs=3600.0,
     preflight=True,
     regrow=False,
+    regrow_check_secs=0.0,
+    scaler=None,
     extra_probe=None,
     poll_secs=1.0,
     shutdown_timeout=600,
@@ -324,6 +415,16 @@ def run_ladder(
     * ``regrow=True`` re-probes blacklisted executors at every relaunch
       (a checkpoint boundary by construction); executors that pass are
       forgiven (``ledger.clear``) and rejoin the next attempt.
+    * ``regrow_check_secs > 0`` (TENSORFLOW mode, with ``regrow``) also
+      re-probes *while a shrunk attempt trains*: every interval the ladder
+      probes the condemned executors and asks the ``scaler`` (default: a
+      :class:`~tensorflowonspark_tpu.control.ClusterScaler` spanning
+      ``min_workers + overhead … num_executors``) whether to grow. A grow
+      verdict posts a preemption warning — workers drain checkpoints,
+      commit a ``preempted`` parting status and exit clean — and the next
+      attempt resumes onto the larger mesh via ``ckpt.reshard_restore``.
+      ``preemption`` failures (this path, and real platform SIGTERMs) never
+      blacklist and never consume the restart budget.
 
     ``ledger`` is injectable for tests; by default a fresh
     :class:`FailureLedger` with this call's budget/window. Returns an
@@ -355,6 +456,10 @@ def run_ladder(
         )
     else:
         run_kwargs.pop("registry_dir", None)
+    if regrow and regrow_check_secs > 0 and scaler is None:
+        scaler = control.ClusterScaler(
+            num_executors, min_size=min_workers + overhead
+        )
     blacklist = set()
     target = num_executors
     relaunches = 0
@@ -395,7 +500,46 @@ def run_ladder(
                     # heartbeat loss); NOT a launch-thread join — ps/
                     # evaluator tasks park until shutdown, so the launch
                     # job outlives training by design
-                    cluster.wait_for_completion(poll_secs, timeout=completion_timeout)
+                    if scaler is not None and regrow_check_secs > 0 and blacklist:
+                        # slice the wait so the ladder can re-probe condemned
+                        # executors and regrow mid-run (a requested regrow
+                        # surfaces as a ``preempted`` failure below)
+                        deadline = (
+                            time.monotonic() + completion_timeout
+                            if completion_timeout else None
+                        )
+                        while True:
+                            slice_secs = regrow_check_secs
+                            if deadline is not None:
+                                slice_secs = min(
+                                    slice_secs,
+                                    max(deadline - time.monotonic(), 0.0),
+                                )
+                            if cluster.wait_for_completion(
+                                poll_secs, timeout=slice_secs
+                            ):
+                                break
+                            if deadline is not None and time.monotonic() >= deadline:
+                                break
+                            if _regrow_poll(
+                                sc, cluster, scaler, blacklist,
+                                num_executors, target, extra_probe,
+                            ):
+                                # drain requested: wait for the parting
+                                # statuses to land, then let classification
+                                # run the relaunch
+                                remaining = (
+                                    max(deadline - time.monotonic(), 0.0)
+                                    if deadline is not None else None
+                                )
+                                cluster.wait_for_completion(
+                                    poll_secs, timeout=remaining
+                                )
+                                break
+                    else:
+                        cluster.wait_for_completion(
+                            poll_secs, timeout=completion_timeout
+                        )
                 if not cluster.tf_status.get("error"):
                     # snapshot BEFORE shutdown: node channels (and with them
                     # the child-side counters) do not survive teardown
@@ -425,6 +569,13 @@ def run_ladder(
         obs.counter(
             "recovery_attempts_total", help="failed cluster attempts entering recovery"
         ).inc()
+        if event.kind == "preemption":
+            # driver-side by necessity: the drained child's own counters die
+            # with its generation's channels
+            obs.counter(
+                "preemptions_drained_total",
+                help="preemption warnings that drained checkpoints before exit",
+            ).inc(max(1, len(event.executor_ids)))
         relaunches += 1
         # tear the failed attempt down BEFORE deciding whether to relaunch:
         # on the final failure the caller still gets their executors back
@@ -492,6 +643,11 @@ def run_ladder(
                     "recovery_shrinks_total",
                     help="relaunches that shrank the cluster to surviving capacity",
                 ).inc()
+            elif new_target > target:
+                obs.counter(
+                    "recovery_regrows_total",
+                    help="relaunches that grew the cluster back toward full size",
+                ).inc()
             obs.gauge(
                 "executors_blacklisted", help="executors currently blacklisted"
             ).set(len(blacklist))
@@ -505,3 +661,7 @@ def run_ladder(
                 " (blacklist: {})".format(sorted(blacklist)) if blacklist else "",
             )
             target = new_target
+            if scaler is not None:
+                # the relaunch is the scaler's actuation landing: reset its
+                # patience streaks so the next verdict starts fresh
+                scaler.observe(new_target)

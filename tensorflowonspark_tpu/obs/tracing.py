@@ -49,6 +49,8 @@ tracing analogue of chaos-obs-coverage):
 ``comm_window``            backprop window a bucket may hide under (retro)
 ``serving_route``          serving-mesh router handling one client request
 ``elastic_relaunch``       recovery-ladder relaunch attempt
+``elastic_regrow``         scaler-initiated regrow restart (drain → relaunch)
+``control_decision``       marker span for a Controller knob move
 
 ``comm_allreduce``/``comm_window`` are *retroactive* spans
 (:func:`record_span`): the bucketed-overlap comm thread records

@@ -211,6 +211,7 @@ class MembershipRegistry:
         self._members = {}    # eid -> {"job","task","joined_at","renewed_at","beat","state"}
         self._roles = {}      # eid -> [job, task_index]
         self._blacklist = {}  # eid -> reason
+        self._target_size = None  # the ladder's journaled plan size
         self._fenced = False
         self._records_since_manifest = 0
         self._manifest_stat = None  # (mtime_ns, size) last seen — cheap fence probe
@@ -224,6 +225,15 @@ class MembershipRegistry:
     def epoch(self):
         with self._lock:
             return self._epoch
+
+    @property
+    def target_size(self):
+        """The executor count the last generation was planned at (journaled
+        with the epoch record, so a restarted driver knows whether the
+        ladder had shrunk — and how far regrow has to go). None until a
+        generation declares one."""
+        with self._lock:
+            return self._target_size
 
     def members(self):
         """eid -> member record (copy), every state included."""
@@ -266,18 +276,28 @@ class MembershipRegistry:
 
     # -- transitions ---------------------------------------------------------
 
-    def begin_generation(self, template=None, reason="launch"):
+    def begin_generation(self, template=None, reason="launch", target_size=None):
         """Open a new cluster generation: epoch += 1, membership cleared,
         roles set from ``template`` (eid -> (job, task_index)). Called once
         per ``TFCluster.run`` attempt — a relaunch is a new generation, and
-        the epoch gap is what fences any stale writer from the old one."""
+        the epoch gap is what fences any stale writer from the old one.
+
+        ``target_size`` journals the executor count this generation was
+        planned at (defaults to the template size), making the ladder's
+        shrink/regrow position durable across a driver restart."""
         with self._lock:
             self._epoch += 1
             self._members = {}
             if template is not None:
                 self._roles = {eid: [j, t] for eid, (j, t) in template.items()}
+            if target_size is not None:
+                self._target_size = int(target_size)
+            elif template is not None:
+                self._target_size = len(template)
             rec = {"op": "epoch", "epoch": self._epoch, "reason": reason,
                    "roles": {str(e): list(r) for e, r in self._roles.items()}}
+            if self._target_size is not None:
+                rec["target"] = self._target_size
             self._journal_locked(rec)
             epoch = self._epoch
         self._publish_gauges()
@@ -504,6 +524,7 @@ class MembershipRegistry:
             "epoch": self._epoch,
             "seq": self._seq,
             "ttl": self.ttl,
+            "target_size": self._target_size,
             "members": {str(e): dict(m) for e, m in self._members.items()},
             "roles": {str(e): list(r) for e, r in self._roles.items()},
             "blacklist": {str(e): r for e, r in self._blacklist.items()},
@@ -626,12 +647,17 @@ class MembershipRegistry:
                             readopted.append(eid)
                     reg._members[eid] = m
                 reg._epoch = max(int(state.get("epoch", 0)), fallback_epoch) + 1
+                if state.get("target_size") is not None:
+                    reg._target_size = int(state["target_size"])
             else:
                 reg._epoch = fallback_epoch + 1
-            reg._journal_locked(
-                {"op": "epoch", "epoch": reg._epoch, "reason": "driver-restart",
-                 "roles": {str(e): list(r) for e, r in reg._roles.items()}}
-            )
+            restart_rec = {
+                "op": "epoch", "epoch": reg._epoch, "reason": "driver-restart",
+                "roles": {str(e): list(r) for e, r in reg._roles.items()},
+            }
+            if reg._target_size is not None:
+                restart_rec["target"] = reg._target_size
+            reg._journal_locked(restart_rec)
             if reg.journal_dir is not None:
                 reg._commit_manifest_locked()  # the fencing record
         if expired_on_recover:
@@ -745,6 +771,8 @@ def _apply_record(state, record):
         state["epoch"] = record.get("epoch", state.get("epoch", 0))
         if record.get("roles"):
             state["roles"] = dict(record["roles"])
+        if record.get("target") is not None:
+            state["target_size"] = record["target"]
         state["members"] = {}
     elif op == "role":
         state.setdefault("roles", {})[eid] = [record.get("job"), record.get("task", 0)]

@@ -16,7 +16,7 @@ import time
 
 import pytest
 
-from tensorflowonspark_tpu import TFCluster, chaos, elastic, reservation
+from tensorflowonspark_tpu import TFCluster, chaos, control, elastic, reservation
 from tensorflowonspark_tpu.TFCluster import InputMode
 from tensorflowonspark_tpu.backends.local import LocalSparkContext
 from tensorflowonspark_tpu.reservation import MessageSocket
@@ -88,6 +88,25 @@ class TestClassifyFailure:
         exc = RuntimeError("feed timeout: queue 'input' still has 3 unconsumed items")
         assert elastic.classify_failure(exc).kind == "feed_timeout"
 
+    def test_preempted_is_first_class_and_budget_exempt(self):
+        # the child's SIGTERM drain commits a ``preempted`` parting status;
+        # the watchdog stamps it into the failure text with the executor id
+        exc = RuntimeError("cluster failed: node worker:1 preempted (executor 3)")
+        event = elastic.classify_failure(exc)
+        assert event.kind == "preemption"
+        assert event.executor_ids == [3]
+        assert event.kind not in elastic.LOSS_KINDS
+        assert event.kind in elastic.BUDGET_EXEMPT_KINDS
+
+    def test_preemption_wins_over_late_expiry_phrasing(self):
+        # a drained child's exit can race a late watchdog expiry into the
+        # same failure text: the warned signal must win the classification
+        exc = RuntimeError(
+            "node worker:1 preempted (executor 3)\nnode worker:1 stopped "
+            "heartbeating: lease expired after 31s without renewal (executor 3)"
+        )
+        assert elastic.classify_failure(exc).kind == "preemption"
+
     def test_unclassifiable_is_unknown(self):
         event = elastic.classify_failure(ValueError("something odd"))
         assert event.kind == "unknown"
@@ -134,6 +153,41 @@ class TestFailureLedger:
         assert ledger.suspects() == [1, 2]
         ledger.clear(1)
         assert ledger.suspects() == [2]
+
+    def test_preemption_never_consumes_the_restart_budget(self):
+        # SIGTERM-then-clean-exit is *warned* downsizing: any number of
+        # drained preemptions must leave the whole budget for real failures
+        ledger = elastic.FailureLedger(max_restarts=1, blacklist_after=1)
+        for _ in range(5):
+            ledger.record(elastic.FailureEvent("preemption", [1], "preempted"))
+        assert ledger.failures_in_window() == 0
+        assert ledger.allow_restart()
+        ledger.record(elastic.FailureEvent("node_exit", [2]))
+        assert ledger.failures_in_window() == 1
+        assert ledger.allow_restart()  # 1 real failure <= max_restarts=1
+        ledger.record(elastic.FailureEvent("node_exit", [2]))
+        assert not ledger.allow_restart()
+
+    def test_preemption_never_counts_toward_blacklist(self):
+        # a preempted-then-returning executor must rejoin without a ledger
+        # entry: no suspects, so the next plan stays at full size and the
+        # executor is back in the template
+        ledger = elastic.FailureLedger(blacklist_after=1)
+        ledger.record(elastic.FailureEvent("preemption", [1], "preempted"))
+        ledger.record(elastic.FailureEvent("preemption", [1], "preempted"))
+        assert ledger.suspects() == []
+        assert elastic.plan_size(2, set(ledger.suspects())) == 2
+        template = TFCluster.build_cluster_template(
+            2, master_node=None, blacklist=set(ledger.suspects())
+        )
+        assert 1 in template
+
+    def test_preemptions_still_appear_in_events(self):
+        # exempt from the budget, not from the record: the trace/result
+        # timeline still shows every drained preemption
+        ledger = elastic.FailureLedger(max_restarts=0)
+        ledger.record(elastic.FailureEvent("preemption", [1], "preempted"))
+        assert [e.kind for _, e in ledger.events()] == ["preemption"]
 
     def test_shrink_never_goes_below_min_workers(self):
         assert elastic.plan_size(4, {3}, min_workers=2) == 3
@@ -415,3 +469,177 @@ def test_node_kill_blacklist_shrink_resharded_resume(tmp_path, monkeypatch):
     assert snap["counters"]["recovery_shrinks_total"]["value"] >= 1
     assert snap["gauges"]["executors_blacklisted"]["value"] >= 1
     assert snap["counters"]["recovery_seconds_total"]["value"] > 0
+
+
+# -- end to end: kill → shrink → forgive → regrow → full-size resume -----------
+
+
+def fn_regrow_train(args, ctx):
+    """The bidirectional-elasticity workload. Life 1 (full size): the victim
+    spins until the once-latched ``node.kill`` lands; the healthy worker
+    trains to ``target_steps`` on the 2×4 mesh, checkpointing async. Life 2
+    (shrunk to 1): resumes on the 1×8 mesh and trains *without a stop
+    condition* — only the driver's regrow preemption warning ends it, and
+    the SIGTERM drain is what lands its final checkpoint. Life 3 (regrown
+    to full size): both workers reshard-restore the drained checkpoint onto
+    the 2×4 mesh; the stop condition (full size AND ``target_steps``) is
+    satisfiable again and task 0 records the outcome."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import ckpt, parallel
+    from tensorflowonspark_tpu.ckpt.reshard import reshard_restore
+    from tensorflowonspark_tpu.models import mnist
+    from tensorflowonspark_tpu.train import SyncDataParallel, checkpoint
+
+    num_workers = ctx.num_workers
+    strategy = SyncDataParallel(
+        parallel.local_mesh({"dp": num_workers, "fsdp": -1}),
+        fsdp=True, min_weight_size=1,
+    )
+    model = mnist.create_model("mlp", hidden=8)
+    optimizer = optax.sgd(0.1)
+    state = strategy.create_state(
+        mnist.make_init_fn(model), optimizer, jax.random.PRNGKey(0)
+    )
+    step = strategy.compile_train_step(
+        mnist.make_loss_fn(model), optimizer, has_aux=True, donate=False
+    )
+    rng = np.random.default_rng(7)
+    batch = strategy.shard_batch(
+        {
+            "image": rng.standard_normal((16, 28, 28)).astype(np.float32),
+            "label": rng.integers(0, 10, 16),
+        }
+    )
+
+    if ctx.executor_id == args["victim"] and not os.path.exists(args["latch"]):
+        # life 1 only: the latch file doubles as the chaos site's
+        # ``once_path``, so once the kill has fired the respawned victim
+        # takes the normal training path below and simply rejoins
+        while True:
+            state, metrics = step(state, batch)
+            jax.block_until_ready(metrics["loss"])
+            time.sleep(args["step_pace_secs"])
+
+    model_dir = args["model_dir"]
+    resumed_from = 0
+    latest = checkpoint.latest_checkpoint(model_dir)
+    if latest:
+        state = reshard_restore(latest, strategy=strategy, target=state)
+        resumed_from = int(jax.device_get(state.step))
+    global_step = int(jax.device_get(state.step))
+
+    with ckpt.AsyncCheckpointEngine(model_dir) as eng:
+        # the stop condition requires the FULL-size mesh: a shrunk life can
+        # only end by the driver's preemption warning, whose drain commits
+        # the engine's pending save before the exit
+        while not (
+            num_workers == args["full_size"] and global_step >= args["target_steps"]
+        ):
+            state, metrics = step(state, batch)
+            jax.block_until_ready(metrics["loss"])
+            global_step += 1
+            time.sleep(args["step_pace_secs"])
+            if ctx.task_index == 0 and global_step % 2 == 0:
+                eng.save(state, global_step)
+        assert eng.drain(timeout=120)
+    if ctx.task_index == 0:
+        with open(os.path.join(model_dir, "done.json"), "w") as f:
+            json.dump(
+                {
+                    "final_step": global_step,
+                    "resumed_from": resumed_from,
+                    "num_workers": num_workers,
+                    "mesh": dict(strategy.mesh.shape),
+                },
+                f,
+            )
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_preempt_drain_regrow_full_size_resume(tmp_path, monkeypatch):
+    """The bidirectional acceptance story: one latched chaos kill takes the
+    victim down → the ledger blacklists it (``blacklist_after=1``) and the
+    ladder shrinks to 1 → the mid-run regrow poll re-probes the condemned
+    executor, finds it healthy, and the scaler votes to grow → the driver
+    posts a preemption warning, the shrunk worker drains its async
+    checkpoint and exits clean (budget-exempt: ``max_relaunches=1`` is
+    already spent on the kill) → the relaunch forgives the victim and
+    regrows to the original world size, reshard-restoring the drained
+    checkpoint onto the full mesh."""
+    monkeypatch.setenv("TOS_MONITOR_INTERVAL", "1")
+    monkeypatch.setenv("TOS_HEARTBEAT_INTERVAL", "0.2")
+    chaos_log = str(tmp_path / "chaos.log")
+    monkeypatch.setenv(chaos.LOG_ENV_VAR, chaos_log)
+    model_dir = str(tmp_path / "model")
+    os.makedirs(model_dir)
+    latch = str(tmp_path / "kill.latch")
+    args = {
+        "model_dir": model_dir,
+        "target_steps": 12,
+        "step_pace_secs": 0.2,
+        "victim": 1,
+        "latch": latch,
+        "full_size": 2,
+    }
+
+    # once_path makes the kill a single event across the victim's lives:
+    # the respawned (forgiven) child finds the latch and trains normally
+    plan = chaos.ChaosPlan(seed=11).site(
+        "node.kill", probability=1.0, max_count=1, victim=1, after_beats=50,
+        once_path=latch,
+    )
+    chaos.install(plan)
+    sc = LocalSparkContext(num_executors=2, task_timeout=900)
+    try:
+        result = elastic.run_ladder(
+            sc, fn_regrow_train, args, num_executors=2,
+            max_relaunches=1, min_workers=1, blacklist_after=1,
+            regrow=True, regrow_check_secs=3.0,
+            scaler=control.ClusterScaler(2, min_size=1, grow_patience=1),
+            input_mode=InputMode.TENSORFLOW, master_node=None,
+            env=CPU_ENV, jax_distributed=False, reservation_timeout=180,
+            shutdown_timeout=240,
+        )
+    finally:
+        sc.stop()
+        chaos.uninstall()
+
+    # the ladder's trajectory: kill → shrink to 1, preempt-drain → regrow
+    # to 2 with the blacklist emptied by forgiveness. The preemption rode
+    # for free: max_relaunches=1 was already spent on the kill, so the run
+    # completing at all proves the budget exemption end to end.
+    assert result.relaunches == 2
+    assert result.num_executors == 2
+    assert result.blacklist == set()
+    kinds = [e.kind for _, e in result.events]
+    assert "preemption" in kinds
+
+    # exactly one kill ever fired (the latch held across lives)
+    with open(chaos_log) as f:
+        kills = [line for line in f if line.strip() == "node.kill"]
+    assert len(kills) == 1
+
+    # training completed back on the FULL mesh, resuming the trajectory the
+    # preempted life drained (its async checkpoint outlived the process)
+    with open(os.path.join(model_dir, "done.json")) as f:
+        done = json.load(f)
+    assert done["num_workers"] == 2
+    assert done["mesh"] == {"dp": 2, "fsdp": 4}
+    assert done["final_step"] >= args["target_steps"]
+    assert done["resumed_from"] >= args["target_steps"], (
+        "the regrown life must resume from the shrunk life's progress, "
+        "not restart"
+    )
+
+    # the bidirectional counters are in the merged snapshot
+    snap = result.metrics
+    assert snap is not None
+    assert snap["counters"]["recovery_shrinks_total"]["value"] >= 1
+    assert snap["counters"]["recovery_regrows_total"]["value"] >= 1
+    assert snap["counters"]["preemptions_drained_total"]["value"] >= 1
+    assert snap["gauges"]["target_world_size"]["value"] == 2
+    assert snap["gauges"]["executors_blacklisted"]["value"] == 0

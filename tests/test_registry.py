@@ -148,6 +148,22 @@ class TestJournalRecovery:
         assert reg2.blacklisted() == [9]
         assert reg2.roles() == {0: ("worker", 0), 1: ("worker", 1)}
 
+    def test_recover_restores_target_size_through_compaction(self, tmp_path):
+        # every epoch record triggers a manifest compaction that truncates
+        # the journal, so the target must survive in the manifest snapshot,
+        # not just the journaled epoch record
+        clk = FakeClock()
+        d = str(tmp_path)
+        reg = registry.MembershipRegistry(ttl=30, journal_dir=d, clock=clk)
+        reg.begin_generation({0: ("worker", 0)}, target_size=4)
+        reg.join(0, "worker", 0)
+        reg2 = registry.MembershipRegistry.recover(d, ttl=30, clock=clk)
+        assert reg2.target_size == 4
+        # ...and a second recovery (reading the fencing manifest the first
+        # one committed) still carries it
+        reg3 = registry.MembershipRegistry.recover(d, ttl=30, clock=clk)
+        assert reg3.target_size == 4
+
     def test_recover_expires_leases_past_ttl(self, tmp_path):
         clk = FakeClock()
         d = str(tmp_path)
