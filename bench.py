@@ -1730,9 +1730,299 @@ def bench_multichip():
         },
         "overlap_fraction": round(statistics.mean(fractions_all), 3),
         "worlds": worlds,
+        "model_axes": {
+            leg: _model_axes_leg(leg) for leg in ("dp_tp", "pipeline", "ring")
+        },
         "host_cores": os.cpu_count() or 1,
         "timesharing_caveat": (os.cpu_count() or 1) < max(ranks),
     }
+
+
+def _model_axes_member(leg):
+    """One model-axis bench leg (``dp_tp`` | ``pipeline`` | ``ring``) in its
+    own 8-cpu-device process: a short numeric-parity run against the
+    single-axis reference first (the same gates the fast test suite pins,
+    here re-proven on the measured configuration), then two timed ON
+    windows whose rates the parent band-validates exactly like the
+    weak-scaling leg's window pairs. Prints one ``MCRESULT 0 {json}``
+    line."""
+    import statistics
+    import sys
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import obs, parallel
+    from tensorflowonspark_tpu.models import transformer
+
+    steps = int(os.environ.get("BENCH_MA_STEPS", "6"))
+    result = {"leg": leg}
+
+    if leg == "dp_tp":
+        from tensorflowonspark_tpu.train import SyncDataParallel
+
+        cfg = dict(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, d_ff=256,
+            max_seq_len=128, dtype="float32",
+        )
+        batch_rows, seq = 16, 64
+        mesh = parallel.local_mesh({"dp": 2, "tp": 4})
+        strategy = SyncDataParallel(mesh, tp=transformer.param_specs)
+        model = transformer.create_model(mesh=mesh, **cfg)
+        opt = optax.adamw(1e-3)
+        rng = np.random.default_rng(0)
+        batches = [
+            {"tokens": rng.integers(3, 256, (batch_rows, seq + 1)).astype(np.int32)}
+            for _ in range(4)
+        ]
+
+        def run(strat, mdl, params0, n):
+            state = strat.create_state(
+                transformer.make_init_fn(mdl, sample_len=8), opt,
+                jax.random.PRNGKey(0),
+            )
+            if params0 is not None:
+                state = state.replace(
+                    params=jax.device_put(params0, strat.param_shardings(params0))
+                )
+            snap = jax.device_get(state.params)
+            step = strat.compile_train_step(
+                transformer.make_loss_fn(mdl), opt, has_aux=True
+            )
+            losses = []
+            for i in range(n):
+                state, metrics = step(state, strat.shard_batch(batches[i % 4]))
+                losses.append(float(np.asarray(jax.device_get(metrics["loss"]))))
+            return snap, losses, step
+
+        # parity: identical params by construction (the tp run's init is the
+        # reference's starting point), identical batches, loss curve ≤2e-5
+        params0, tp_losses, _ = run(strategy, model, None, 4)
+        ref_strategy = SyncDataParallel(parallel.local_mesh({"dp": 8}))
+        ref_model = transformer.create_model(**cfg)
+        _, ref_losses, _ = run(ref_strategy, ref_model, params0, 4)
+        parity = max(abs(a - b) for a, b in zip(tp_losses, ref_losses))
+
+        # throughput: fresh state, warmed step, two band-validated windows
+        state = strategy.create_state(
+            transformer.make_init_fn(model, sample_len=8), opt,
+            jax.random.PRNGKey(0),
+        )
+        step = strategy.compile_train_step(
+            transformer.make_loss_fn(model), opt, has_aux=True
+        )
+        sharded = [strategy.shard_batch(b) for b in batches]
+        for b in sharded:  # compile + cold-cache warmup off-window
+            state, metrics = step(state, b)
+        float(np.asarray(jax.device_get(metrics["loss"])))
+
+        def window(n):
+            nonlocal state, metrics
+            t0 = time.perf_counter()
+            for i in range(n):
+                state, metrics = step(state, sharded[i % 4])
+            float(np.asarray(jax.device_get(metrics["loss"])))
+            return n * batch_rows * seq / (time.perf_counter() - t0)
+
+        rates = [window(steps), window(steps)]
+        result.update({
+            "mesh": "dp2 x tp4",
+            "window_tokens_per_s": [round(r, 1) for r in rates],
+            "tp_params_sharded": int(obs.gauge("tp_params_sharded").value),
+            "loss_parity_max_abs": parity,
+            "parity_ok": parity <= 2e-5,
+        })
+
+    elif leg == "pipeline":
+        from tensorflowonspark_tpu.parallel.pipeline_parallel import (
+            Pipeline1F1B,
+            split_microbatches,
+        )
+
+        width, n_stages, n_micro, rows = 256, 4, 8, 64
+        rng = np.random.default_rng(1)
+        params = [
+            {"w": jnp.asarray(rng.standard_normal((width, width)) / 8.0,
+                              jnp.float32)}
+            for _ in range(n_stages)
+        ]
+        x = jnp.asarray(rng.standard_normal((rows, width)), jnp.float32)
+        t = jnp.asarray(rng.standard_normal((rows, width)), jnp.float32)
+
+        def stage_fn(p, xx):
+            h = xx
+            for _ in range(4):
+                h = jnp.tanh(h @ p["w"])
+            return h
+
+        def loss_fn(y, target):
+            return jnp.mean((y - target) ** 2)
+
+        def sequential(ps, xx, tt):
+            y = xx
+            for p in ps:
+                y = stage_fn(p, y)
+            return loss_fn(y, tt)
+
+        ref_loss = float(jax.jit(sequential)(params, x, t))
+        mbs, tgts = split_microbatches(x, n_micro), split_microbatches(t, n_micro)
+
+        def window(pipe, n):
+            bubbles, overlaps, losses = [], [], []
+            t0 = time.perf_counter()
+            for _ in range(n):
+                loss, _grads = pipe.step(mbs, tgts)
+                losses.append(float(loss))
+                bubbles.append(pipe.last_stats["bubble_fraction"])
+                overlaps.append(pipe.last_stats["overlap_fraction"])
+            rate = n * rows / (time.perf_counter() - t0)
+            return rate, bubbles, overlaps, losses
+
+        pipe = Pipeline1F1B(stage_fn, params, loss_fn, overlap=True)
+        try:
+            window(pipe, 1)  # compile off-window
+            r1, b1, o1, losses = window(pipe, steps)
+            r2, b2, o2, _ = window(pipe, steps)
+        finally:
+            pipe.close()
+        pipe_off = Pipeline1F1B(stage_fn, params, loss_fn, overlap=False)
+        try:
+            window(pipe_off, 1)
+            off_rate, off_b, _, _ = window(pipe_off, steps)
+        finally:
+            pipe_off.close()
+        parity = abs(losses[0] - ref_loss)
+        result.update({
+            "n_stages": n_stages,
+            "n_microbatches": n_micro,
+            "window_samples_per_s": [round(r1, 1), round(r2, 1)],
+            "off_samples_per_s": round(off_rate, 1),
+            "bubble_fraction": round(statistics.mean(b1 + b2), 3),
+            "bubble_fraction_off": round(statistics.mean(off_b), 3),
+            "bubble_fraction_theory": round(
+                (n_stages - 1.0) / (2.0 * n_micro + n_stages - 1.0), 3
+            ),
+            "overlap_fraction": round(statistics.mean(o1 + o2), 3),
+            "loss_parity_max_abs": parity,
+            "parity_ok": parity <= 1e-6,
+        })
+
+    elif leg == "ring":
+        from tensorflowonspark_tpu.data import TextPipeline, Tokenizer
+
+        cfg = dict(
+            vocab_size=1024, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+            max_seq_len=256, dtype="float32",
+        )
+        batch_rows, seq = 4, 256
+        mesh = parallel.local_mesh({"dp": 2, "sp": 4})
+        tmp = tempfile.mkdtemp(prefix="bench_ring_corpus_")
+        files = make_lm_corpus(tmp, n_records=2048)
+        pipe = TextPipeline(
+            files, Tokenizer(kind="word", vocab_size=1024),
+            seq_len=seq, batch_size=batch_rows, seed=0, epochs=None,
+        )
+        stream = iter(pipe)
+        slabs = [
+            {k: jnp.asarray(v) for k, v in next(stream).items()} for _ in range(4)
+        ]
+        plain = transformer.create_model(attention="plain", **cfg)
+        params = plain.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+        )["params"]
+        ring = transformer.create_model(mesh=mesh, attention="ring", **cfg)
+
+        def fwd(mdl, slab):
+            return mdl.apply(
+                {"params": params}, slab["tokens"],
+                positions=slab["positions"], segment_ids=slab["segment_ids"],
+            )
+
+        real = np.asarray(slabs[0]["segment_ids"]) > 0
+        parity = float(
+            np.abs(
+                np.asarray(fwd(ring, slabs[0]))[real]
+                - np.asarray(fwd(plain, slabs[0]))[real]
+            ).max()
+        )
+
+        ring_jit = jax.jit(
+            lambda tok, pos, seg: ring.apply(
+                {"params": params}, tok, positions=pos, segment_ids=seg
+            )
+        )
+        jax.block_until_ready(
+            ring_jit(slabs[0]["tokens"], slabs[0]["positions"],
+                     slabs[0]["segment_ids"])
+        )
+
+        def window(n):
+            t0 = time.perf_counter()
+            out = None
+            for i in range(n):
+                s = slabs[i % 4]
+                out = ring_jit(s["tokens"], s["positions"], s["segment_ids"])
+            jax.block_until_ready(out)
+            return n * batch_rows * seq / (time.perf_counter() - t0)
+
+        rates = [window(steps), window(steps)]
+        result.update({
+            "mesh": "dp2 x sp4",
+            "seq_len": seq,
+            "window_tokens_per_s": [round(r, 1) for r in rates],
+            "loss_parity_max_abs": parity,
+            "parity_ok": parity <= 2e-5,
+        })
+
+    else:
+        raise ValueError("unknown model-axes leg: {}".format(leg))
+
+    print("MCRESULT 0 {}".format(json.dumps(result)), flush=True)
+    sys.stdout.flush()
+
+
+def _model_axes_leg(leg):
+    """Spawn one model-axis leg subprocess (8 forced cpu devices) and
+    band-validate its two ON windows with the same symmetric-band check the
+    weak-scaling worlds use — one pair per leg, ``pair_valid`` says whether
+    the two windows agreed."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "model_axes_member", leg],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        timeout=900,
+    )
+    payload = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("MCRESULT "):
+            payload = json.loads(line.split(" ", 2)[2])
+    if payload is None:
+        raise RuntimeError(
+            "model-axes leg {} produced no MCRESULT; log:\n{}".format(
+                leg, proc.stdout[-2000:]
+            )
+        )
+    key = (
+        "window_samples_per_s"
+        if "window_samples_per_s" in payload
+        else "window_tokens_per_s"
+    )
+    w1, w2 = payload[key]
+    valid, invalid = partition_pairs([w1], [w2])
+    if not valid:
+        valid = [least_implausible_pair([w1], [w2])]
+    payload[key.replace("window_", "")] = round(sum(valid[0]) / 2.0, 1)
+    payload["pair_valid"] = not invalid
+    payload["confidence"] = confidence_fields(1, 1, invalid_pairs=len(invalid))
+    return payload
 
 
 def bench_decode(tiny):
@@ -1933,5 +2223,7 @@ if __name__ == "__main__":
         _multichip_member(
             int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]), sys.argv[5]
         )
+    elif len(sys.argv) > 1 and sys.argv[1] == "model_axes_member":
+        _model_axes_member(sys.argv[2])
     else:
         main()

@@ -118,9 +118,12 @@ if [[ "$MULTICHIP" == "1" ]]; then
   # multi-process gloo legs (tests/test_multichip.py): 2-rank host
   # all-reduce determinism + bucketed-overlap bit-identity smoke runs
   # everywhere; the 4-rank weak-scaling smoke marks itself skipped below
-  # 4 cores (four lockstep jax worlds on one core prove nothing)
-  exec python -m pytest tests/test_multichip.py -q -m "not chaos" \
-    ${EXTRA[@]+"${EXTRA[@]}"}
+  # 4 cores (four lockstep jax worlds on one core prove nothing). The
+  # model-axis legs (tests/test_model_axes.py) ride along: fast dp×tp and
+  # 1F1B-pipeline numeric-parity gates on forced cpu devices, plus the
+  # 2-rank dp×tp gloo world
+  exec python -m pytest tests/test_multichip.py tests/test_model_axes.py -q \
+    -m "not chaos" ${EXTRA[@]+"${EXTRA[@]}"}
 fi
 
 if [[ "$PERF_SMOKE" == "1" ]]; then

@@ -259,16 +259,25 @@ class Transformer(nn.Module):
     mesh: object = None
 
     def _constrain(self, x):
-        """Keep activations sharded batch×seq across the mesh."""
+        """Keep activations sharded batch×seq across the mesh. An axis whose
+        size does not divide its dim is dropped (degrade-to-replicated, same
+        contract as :func:`param_specs`) — real text slabs may carry any
+        sequence length; ring attention pads internally."""
         if self.mesh is None:
             return x
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         names = self.mesh.axis_names
-        batch = tuple(a for a in ("dp", "fsdp") if a in names) or None
+        sizes = dict(zip(names, self.mesh.devices.shape))
+        batch, div = [], 1
+        for a in ("dp", "fsdp"):
+            if a in names and x.shape[0] % (div * sizes[a]) == 0:
+                batch.append(a)
+                div *= sizes[a]
+        batch = tuple(batch) or None
         if batch is not None and len(batch) == 1:
             batch = batch[0]
-        seq = "sp" if "sp" in names else None
+        seq = "sp" if "sp" in names and x.shape[1] % sizes["sp"] == 0 else None
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(self.mesh, P(batch, seq, None))
         )
@@ -318,14 +327,20 @@ _TP_RULES = (
 )
 
 
-def param_specs(params, mesh):
+def param_specs(params, mesh, tp_axis="tp"):
     """PartitionSpecs for the transformer's params over ``mesh``: tp rules
     above, fsdp for what they leave unnamed, replication for the rest. Axes
     not present in the mesh are dropped from the specs, so the same rules
-    serve dp-only, dp×tp, fsdp×sp, etc."""
+    serve dp-only, dp×tp, fsdp×sp, etc. ``tp_axis`` renames the mesh axis
+    the tensor-parallel dims land on (hybrid meshes sometimes spell it
+    differently); the rules themselves always say ``"tp"``. An axis whose
+    mesh size does not divide the dim it names is dropped for that dim
+    (same degrade-to-replicated contract as the fsdp rules), so undersized
+    debug models still place."""
     from jax.sharding import PartitionSpec as P
 
     names = set(mesh.axis_names)
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
 
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     specs = {}
@@ -336,7 +351,13 @@ def param_specs(params, mesh):
         spec = None
         for pattern, template in _TP_RULES:
             if re.search(pattern, key):
-                spec = P(*(a if a in names else None for a in template))
+                axes = [tp_axis if a == "tp" else a for a in template]
+                spec = P(*(
+                    a
+                    if a in names and leaf.shape[i] % sizes[a] == 0
+                    else None
+                    for i, a in enumerate(axes)
+                ))
                 break
         if spec is None:
             spec = P(*([None] * leaf.ndim))

@@ -47,16 +47,19 @@ tracing analogue of chaos-obs-coverage):
 ``ckpt_snapshot``          checkpoint snapshot handoff to the async engine
 ``comm_allreduce``         one bucketed all-reduce on the comm thread (retro)
 ``comm_window``            backprop window a bucket may hide under (retro)
+``pipeline_stage``         one 1F1B stage op (fwd/bwd/fused loss) (retro)
+``pipeline_transfer``      stage-boundary activation/cotangent hop (retro)
 ``serving_route``          serving-mesh router handling one client request
 ``elastic_relaunch``       recovery-ladder relaunch attempt
 ``elastic_regrow``         scaler-initiated regrow restart (drain → relaunch)
 ``control_decision``       marker span for a Controller knob move
 
-``comm_allreduce``/``comm_window`` are *retroactive* spans
-(:func:`record_span`): the bucketed-overlap comm thread records
+``comm_allreduce``/``comm_window`` and ``pipeline_stage``/
+``pipeline_transfer`` are *retroactive* spans (:func:`record_span`): the
+bucketed-overlap comm thread and the 1F1B stage/comm threads record
 perf-counter intervals while overlapping compute, and the step publishes
 them afterwards with explicit timestamps so the merger can draw the comm
-track without the tracer ever being on the hot path.
+and pipeline tracks without the tracer ever being on the hot path.
 """
 
 import os

@@ -125,6 +125,15 @@ def ring_attention_sharded(
     axis — same math, no ring. ``segment_ids`` (``int32 [B, L]``, 0 =
     padding) fences packed sequences; it is sharded over ``axis`` like the
     sequence dim and rotated with K/V inside the ring.
+
+    A sequence length that does not divide the ring size is padded up to
+    the next multiple and the pad rows sliced off after — exact, because
+    appended keys carry segment id 0, which never equals a real (>= 1)
+    segment (causal-only inputs get an all-ones synthetic segment tensor
+    for the same fence; under pure causal masking the appended tail is
+    already unreachable). Real text slabs therefore run the ring at any
+    ``[B, L]`` geometry; only probe batches whose *batch* dim cannot shard
+    fall back.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -138,10 +147,33 @@ def ring_attention_sharded(
     batch_div = 1
     for a in batch:
         batch_div *= sizes[a]
-    if q.shape[0] % batch_div or q.shape[2] % sizes[axis] or k.shape[2] % sizes[axis]:
-        # shapes that don't divide the mesh (e.g. module.init on a [1, small]
-        # probe batch) fall back to the single-block path — same math
+    n_ring = sizes[axis]
+    if q.shape[0] % batch_div or (
+        k.shape[2] != q.shape[2]
+        and (q.shape[2] % n_ring or k.shape[2] % n_ring)
+    ):
+        # batch dims that don't shard (e.g. module.init on a [1, small]
+        # probe batch) — or non-self-attention geometry that doesn't divide
+        # — fall back to the single-block path: same math
         return plain_attention(q, k, v, causal=causal, scale=scale, segment_ids=segment_ids)
+    pad = (-q.shape[2]) % n_ring
+    if pad and k.shape[2] == q.shape[2]:
+        l_real = q.shape[2]
+        seg = segment_ids
+        if seg is None and not causal:
+            # non-causal queries would see the appended keys; a synthetic
+            # all-ones segment tensor fences them (pad columns get id 0)
+            seg = jnp.ones((q.shape[0], l_real), jnp.int32)
+        q, k, v = (
+            jnp.pad(t, ((0, 0), (0, 0), (0, pad), (0, 0))) for t in (q, k, v)
+        )
+        if seg is not None:
+            seg = jnp.pad(seg, ((0, 0), (0, pad)))
+        out = ring_attention_sharded(
+            q, k, v, mesh, causal=causal, scale=scale, axis=axis,
+            segment_ids=seg,
+        )
+        return out[:, :, :l_real]
     bspec = batch if len(batch) > 1 else (batch[0] if batch else None)
     spec = P(bspec, None, axis, None)
     from tensorflowonspark_tpu.parallel.collectives import shard_map

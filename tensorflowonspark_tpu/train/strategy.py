@@ -88,15 +88,31 @@ class SyncDataParallel:
             state, metrics = step(state, strategy.shard_batch(batch))
     """
 
-    def __init__(self, mesh=None, fsdp=False, min_weight_size=2**14, param_spec_fn=None):
+    def __init__(self, mesh=None, fsdp=False, min_weight_size=2**14, param_spec_fn=None, tp=False):
         """``param_spec_fn(params_shape, mesh) -> PartitionSpec pytree`` lets a
         model supply its own placement rules (e.g.
         :func:`tensorflowonspark_tpu.models.transformer.param_specs` for
         tensor parallelism); default placement is replicate (pure DP) or the
-        generic FSDP rules."""
+        generic FSDP rules.
+
+        ``tp`` turns on tensor parallelism over the mesh's ``tp`` axis:
+        pass the model's placement rules directly (``tp=transformer.param_specs``)
+        or ``tp=True`` alongside an explicit ``param_spec_fn``. Only the model
+        knows which dims are column- vs row-parallel, so ``tp`` without rules
+        is an error, as is a mesh without a ``tp`` axis. ``fsdp`` composes:
+        the model's tp specs win where they touch, the ZeRO-3 overlay shards
+        the leftovers (dp×tp and dp×fsdp×tp both come from the same rules)."""
         self.mesh = mesh if mesh is not None else build_mesh()
         self.fsdp = fsdp
         self.min_weight_size = min_weight_size
+        if callable(tp):
+            if param_spec_fn is not None and param_spec_fn is not tp:
+                raise ValueError(
+                    "pass the placement rules once: tp=<spec_fn> or "
+                    "param_spec_fn=<spec_fn>, not two different functions"
+                )
+            param_spec_fn, tp = tp, True
+        self.tp = bool(tp)
         self.param_spec_fn = param_spec_fn
         if fsdp and "fsdp" not in self.mesh.axis_names:
             raise ValueError(
@@ -104,6 +120,19 @@ class SyncDataParallel:
                     self.mesh.axis_names
                 )
             )
+        if self.tp:
+            if "tp" not in self.mesh.axis_names:
+                raise ValueError(
+                    "tp=... requires a mesh with a 'tp' axis; got {}".format(
+                        self.mesh.axis_names
+                    )
+                )
+            if self.param_spec_fn is None:
+                raise ValueError(
+                    "tp=True needs the model's placement rules: pass "
+                    "tp=<param_spec_fn> (e.g. models.transformer.param_specs) "
+                    "or param_spec_fn= explicitly"
+                )
 
     # -- placement ------------------------------------------------------------
 
@@ -134,21 +163,27 @@ class SyncDataParallel:
         else:
             rep = PartitionSpec()
             specs = jax.tree.map(lambda _: rep, params_shape)
-        if self.fsdp:
+        if self.fsdp or self.tp:
             from tensorflowonspark_tpu import obs
             from tensorflowonspark_tpu.parallel.sharding import _spec_axes
 
-            n_sharded = sum(
-                1
+            spec_leaves = [
+                s
                 for s in jax.tree.leaves(
                     specs, is_leaf=lambda n: isinstance(n, PartitionSpec)
                 )
-                if isinstance(s, PartitionSpec) and "fsdp" in _spec_axes(s)
-            )
-            obs.gauge(
-                "fsdp_params_sharded",
-                help="param arrays sharded along the fsdp axis (ZeRO-3)",
-            ).set(n_sharded)
+                if isinstance(s, PartitionSpec)
+            ]
+            if self.fsdp:
+                obs.gauge(
+                    "fsdp_params_sharded",
+                    help="param arrays sharded along the fsdp axis (ZeRO-3)",
+                ).set(sum(1 for s in spec_leaves if "fsdp" in _spec_axes(s)))
+            if self.tp:
+                obs.gauge(
+                    "tp_params_sharded",
+                    help="param arrays sharded along the tp axis (tensor parallelism)",
+                ).set(sum(1 for s in spec_leaves if "tp" in _spec_axes(s)))
         return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs)
 
     def shard_batch(self, batch):
@@ -401,10 +436,16 @@ class BucketedOverlap:
     donates (params, opt_state), which no in-flight collective can
     reference because :meth:`step` drains the comm thread first.
 
-    Scope: replicated-params data parallelism (each process steps its own
-    replica, like the reference's ``MultiWorkerMirroredStrategy``); FSDP
-    params sync through XLA's sharding-derived collectives instead —
-    constructor rejects an FSDP strategy.
+    Scope: data parallelism over params that are replicated across the
+    *processes* of the host group — pure dp (each process steps its own
+    replica, like the reference's ``MultiWorkerMirroredStrategy``) and dp×tp
+    (params sharded along an in-process ``tp`` mesh axis: the grad fetch
+    gathers each leaf to host, the dp all-reduce averages the full arrays,
+    and the apply program re-shards through pinned output shardings).
+    FSDP params are NOT supported — their leaves are partitions of the
+    per-process replica, so a host-side dp all-reduce of gathered shards
+    would double-count the reduce-scatter XLA already derives from the
+    shardings; the constructor rejects that composition by axis name.
 
     Per-step stats land in :attr:`last_stats` and the
     ``comm_overlap_fraction`` gauge::
@@ -420,9 +461,13 @@ class BucketedOverlap:
 
         if getattr(strategy, "fsdp", False):
             raise ValueError(
-                "BucketedOverlap needs replicated params; FSDP-sharded "
-                "params already sync through XLA's sharding-derived "
-                "reduce-scatter/all-gather"
+                "BucketedOverlap cannot sync params sharded along mesh "
+                "axes ('fsdp',): each process holds only a partition of "
+                "its replica, and FSDP params already sync through XLA's "
+                "sharding-derived reduce-scatter/all-gather. Supported "
+                "compositions: replicated params (pure dp) and tp-sharded "
+                "params (dp x tp) — only the replicated dp axis is "
+                "all-reduced host-side."
             )
         self.strategy = strategy
         self.loss_fn = loss_fn
@@ -459,7 +504,7 @@ class BucketedOverlap:
             self._grad_fns[key] = fn
         return fn
 
-    def _apply(self):
+    def _apply(self, params, opt_state, step):
         if self._apply_fn is None:
             import optax
 
@@ -469,7 +514,21 @@ class BucketedOverlap:
                 params = optax.apply_updates(params, updates)
                 return params, opt_state, step + 1
 
-            self._apply_fn = jax.jit(apply, donate_argnums=(0, 1))
+            # pin output shardings to the inputs': the accumulated grads
+            # arrive as host arrays, and without the pin a tp-sharded params
+            # tree would come back with whatever placement jit infers from
+            # the unsharded operands — the next microbatch's grad program
+            # would then recompile against moved params
+            kw = {}
+            try:
+                kw["out_shardings"] = (
+                    jax.tree.map(lambda x: x.sharding, params),
+                    jax.tree.map(lambda x: x.sharding, opt_state),
+                    step.sharding,
+                )
+            except AttributeError:
+                pass  # host-numpy state (unit tests): let jit place outputs
+            self._apply_fn = jax.jit(apply, donate_argnums=(0, 1), **kw)
         return self._apply_fn
 
     # -- bucket partition ------------------------------------------------------
@@ -591,9 +650,9 @@ class BucketedOverlap:
 
         grads = jax.tree.unflatten(self._treedef, acc)
         scale = jnp.asarray(1.0 / len(microbatches), dtype=jnp.float32)
-        params, opt_state, step = self._apply()(
-            state.params, state.opt_state, state.step, grads, scale
-        )
+        params, opt_state, step = self._apply(
+            state.params, state.opt_state, state.step
+        )(state.params, state.opt_state, state.step, grads, scale)
         new_state = TrainState(step, params, opt_state, state.model_state)
         loss = jnp.mean(jnp.stack(losses))
         if self.group is not None and self.group.world > 1:

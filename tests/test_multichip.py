@@ -235,12 +235,85 @@ class TestBucketedOverlap:
         assert len(sched._buckets) == 2, sched._buckets
         sched.close()
 
-    def test_rejects_fsdp_strategy(self):
+    def test_rejects_fsdp_strategy_naming_axes(self):
         from tensorflowonspark_tpu.train import BucketedOverlap
 
         strategy, _, loss_fn, opt = _mlp_setup(fsdp=True)
-        with pytest.raises(ValueError, match="replicated params"):
+        # the error must name the offending axes AND the supported
+        # compositions (satellite contract of the model-axis PR)
+        with pytest.raises(ValueError, match=r"axes \('fsdp',\)") as ei:
             BucketedOverlap(strategy, loss_fn, opt)
+        assert "dp x tp" in str(ei.value)
+
+    def test_tp_sharded_params_sync_and_stay_sharded(self):
+        """dp×tp composition: grads all-reduce over dp only (here: a single
+        process, so the step is pure grad accumulation), the apply program
+        keeps params tp-sharded, and the trajectory matches an unsharded
+        reference exactly."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from tensorflowonspark_tpu import parallel
+        from tensorflowonspark_tpu.models import transformer
+        from tensorflowonspark_tpu.train import BucketedOverlap, SyncDataParallel
+
+        if jax.device_count() < 8:
+            pytest.skip("needs 8 cpu devices")
+        cfg = dict(vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64,
+                   dtype="float32", attention="plain")
+        mesh = parallel.local_mesh({"dp": 2, "tp": 4})
+        model = transformer.create_model(mesh=mesh, **cfg)
+        tloss = transformer.make_loss_fn(model)
+        opt = optax.sgd(0.1)
+        strategy = SyncDataParallel(mesh, tp=transformer.param_specs)
+        state = strategy.create_state(
+            transformer.make_init_fn(model), opt, jax.random.PRNGKey(0)
+        )
+        params0 = jax.device_get(state.params)
+        spec0 = jax.tree.map(lambda x: x.sharding.spec, state.params)
+        flat_axes = {
+            ax
+            for s in jax.tree.leaves(spec0, is_leaf=lambda n: hasattr(n, "index"))
+            for ax in s
+            if isinstance(ax, str)
+        }
+        assert "tp" in flat_axes, flat_axes
+
+        def loss_fn(params, batch):
+            return tloss(params, batch)[0]
+
+        rng = np.random.default_rng(7)
+        mbs = [
+            strategy.shard_batch(
+                {"tokens": rng.integers(0, 64, (4, 16)).astype(np.int32)}
+            )
+            for _ in range(2)
+        ]
+        sched = BucketedOverlap(strategy, loss_fn, opt)
+        state, _ = sched.step(state, mbs)
+        state, metrics = sched.step(state, mbs)
+        sched.close()
+        spec_after = jax.tree.map(lambda x: x.sharding.spec, state.params)
+        assert spec0 == spec_after  # the apply program pinned out_shardings
+
+        # unsharded reference: identical grad-accumulation SGD trajectory
+        model_u = transformer.create_model(mesh=None, **cfg)
+        loss_u = transformer.make_loss_fn(model_u)
+        params, opt_state = params0, opt.init(params0)
+        host_mbs = [jax.device_get(mb) for mb in mbs]
+        for _ in range(2):
+            grads = None
+            for mb in host_mbs:
+                g = jax.grad(lambda p, b: loss_u(p, b)[0])(params, mb)
+                grads = g if grads is None else jax.tree.map(jnp.add, grads, g)
+            grads = jax.tree.map(lambda g: g / len(host_mbs), grads)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+        probe = host_mbs[0]
+        ref = float(loss_u(params, probe)[0])
+        got = float(loss_u(jax.device_get(state.params), probe)[0])
+        assert abs(ref - got) <= 2e-5, (ref, got)
 
     def test_empty_microbatches_raise(self):
         import jax
@@ -392,6 +465,93 @@ def _run_world(tmp_path, num_procs, scenario="plain"):
         with open(tmp_path / "rank{}.json".format(pid)) as f:
             results.append(json.load(f))
     return results
+
+
+def _tp_world_member(pid, num_procs, coord_port, out_dir):
+    """dp across processes × tp across the member's two local cpu devices."""
+    from tensorflowonspark_tpu.testing import join_cpu_world
+
+    join_cpu_world(pid, num_procs, coord_port, local_devices=2)
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tensorflowonspark_tpu import parallel
+    from tensorflowonspark_tpu.parallel.hostreduce import HostAllReduceGroup
+    from tensorflowonspark_tpu.train import BucketedOverlap, SyncDataParallel
+
+    def spec_fn(params, mesh):
+        # Megatron column/row pair for the 2-layer MLP
+        return {"w1": P(None, "tp"), "w2": P("tp", None)}
+
+    def init_fn(rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "w1": jax.random.normal(k1, (64, 64)) * 0.1,
+            "w2": jax.random.normal(k2, (64, 8)) * 0.1,
+        }
+
+    def loss_fn(params, batch):
+        h = jnp.tanh(batch["x"] @ params["w1"])
+        return jnp.mean((h @ params["w2"] - batch["y"]) ** 2)
+
+    opt = optax.adam(1e-2)
+    mesh = parallel.local_mesh({"tp": 2})
+    strategy = SyncDataParallel(mesh, tp=spec_fn)
+    out = {"pid": pid}
+    with HostAllReduceGroup(pid, num_procs) as group:
+        state = strategy.create_state(init_fn, opt, jax.random.PRNGKey(0))
+        sched = BucketedOverlap(strategy, loss_fn, opt, group=group)
+        rng = np.random.default_rng(100 + pid)  # per-rank data (the dp axis)
+        mbs = _microbatches(strategy, rng, 2)
+        losses = []
+        for _ in range(4):
+            state, metrics = sched.step(state, mbs)
+            losses.append(float(metrics["loss"]))
+        sched.close()
+        out["losses"] = losses
+        axes = {
+            ax
+            for leaf in jax.tree.leaves(state.params)
+            for ax in leaf.sharding.spec
+            if isinstance(ax, str)
+        }
+        out["tp_sharded_after"] = "tp" in axes
+    with open(os.path.join(out_dir, "rank{}.json".format(pid)), "w") as f:
+        json.dump(out, f)
+
+
+@pytest.mark.slow
+def test_two_rank_dp_tp_world(tmp_path):
+    """dp over 2 gloo processes × tp over 2 local cpu devices each: the
+    host all-reduce averages only the (replicated) dp axis, every rank sees
+    the same global-mean loss trajectory, training moves, and params stay
+    tp-sharded through the apply program."""
+    import functools
+
+    coord_port = util.find_free_port()
+    procs = [
+        util.spawn_process(
+            functools.partial(
+                _tp_world_member, pid, 2, coord_port, str(tmp_path)
+            ),
+            name="tp-{}".format(pid),
+        )
+        for pid in range(2)
+    ]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=300)
+    assert all(p.exitcode == 0 for p in procs), [p.exitcode for p in procs]
+    results = []
+    for pid in range(2):
+        with open(tmp_path / "rank{}.json".format(pid)) as f:
+            results.append(json.load(f))
+    assert results[0]["losses"] == results[1]["losses"]
+    assert results[0]["losses"][-1] < results[0]["losses"][0]
+    assert all(r["tp_sharded_after"] for r in results)
 
 
 @pytest.mark.slow

@@ -115,6 +115,76 @@ def test_ring_segment_mask_matches_unpacked(causal):
     np.testing.assert_allclose(out[:, :, real[0]], ref[:, :, real[0]], atol=2e-5)
 
 
+class TestRingEdgeGeometry:
+    """Ring attention at awkward geometry: ring size ≥ 3, sequence length
+    not divisible by the ring, whole trailing shards that are pure padding.
+    The pad-to-ring-multiple path must stay exact against the same
+    packed-vs-unpacked oracle (and plain attention where nothing is
+    packed)."""
+
+    def _mesh(self, axes):
+        from tensorflowonspark_tpu import parallel
+
+        if jax.device_count() < 8:
+            pytest.skip("needs 8 cpu devices")
+        return parallel.local_mesh(axes)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_nondivisible_length_matches_plain(self, causal):
+        # L=30 on an 8-ring: pad 2, slice back — exact in both mask modes
+        mesh = self._mesh({"sp": 8})
+        rng = np.random.default_rng(9)
+        q, k, v = (
+            jnp.asarray(rng.standard_normal((2, 2, 30, 16)), jnp.float32)
+            for _ in range(3)
+        )
+        ref = plain_attention(q, k, v, causal=causal)
+        out = ring_attention_sharded(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_packed_nondivisible_matches_unpacked(self, causal):
+        mesh = self._mesh({"sp": 8})
+        q, k, v, seg, spans = _packed_case(b=2, l=30, seed=5, segs=(11, 7, 9))
+        ref, real = _unpacked_reference(q, k, v, seg, spans, causal)
+        out = np.asarray(
+            ring_attention_sharded(q, k, v, mesh, causal=causal, segment_ids=seg)
+        )
+        assert np.all(np.isfinite(out))
+        np.testing.assert_allclose(out[:, :, real[0]], ref[:, :, real[0]], atol=2e-5)
+
+    def test_all_pad_trailing_shards(self):
+        # real tokens end at 18 of 32: on an 8-ring the last 3 local blocks
+        # are pure padding — outputs stay finite, real positions exact
+        mesh = self._mesh({"sp": 8})
+        q, k, v, seg, spans = _packed_case(b=2, l=32, seed=6, segs=(11, 7))
+        ref, real = _unpacked_reference(q, k, v, seg, spans, True)
+        out = np.asarray(
+            ring_attention_sharded(q, k, v, mesh, causal=True, segment_ids=seg)
+        )
+        assert np.all(np.isfinite(out))
+        np.testing.assert_allclose(out[:, :, real[0]], ref[:, :, real[0]], atol=2e-5)
+
+    def test_nondivisible_gradients_match_plain(self):
+        mesh = self._mesh({"dp": 2, "sp": 4})
+        rng = np.random.default_rng(10)
+        q, k, v = (
+            jnp.asarray(rng.standard_normal((2, 2, 30, 16)), jnp.float32)
+            for _ in range(3)
+        )
+
+        def ring_loss(q, k, v):
+            return (ring_attention_sharded(q, k, v, mesh, causal=True) ** 2).sum()
+
+        def plain_loss(q, k, v):
+            return (plain_attention(q, k, v, causal=True) ** 2).sum()
+
+        gr = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        gp = jax.grad(plain_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gp):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
 class TestTransformerPacked:
     """Model-level equivalence: packed [1 row: s1+s2] logits must equal the
     per-sequence unpacked forward passes, for every attention impl, and the
