@@ -991,7 +991,9 @@ def bench_feed_plane():
                 batch = feed.next_batch(batch_size, as_numpy=True)
                 n += len(batch["x"]) if isinstance(batch, dict) and "x" in batch else 0
             dt = _time.perf_counter() - t0
-            t.join()
+            # producer already sent its end-of-feed sentinel by the time the
+            # feed loop exits; the timeout only guards a wedged shm teardown
+            t.join(timeout=60.0)
             return len(rows) / dt
         finally:
             mgr.shutdown()
@@ -1113,7 +1115,10 @@ def bench_serving(tiny):
                     lat.extend(mine)
                     shed[0] += my_shed
 
-            threads = [threading.Thread(target=worker, args=(c,)) for c in clients]
+            threads = [
+                threading.Thread(target=worker, args=(c,), daemon=True)
+                for c in clients
+            ]
             t0 = _time.perf_counter()
             for t in threads:
                 t.start()
@@ -1175,7 +1180,10 @@ def bench_serving(tiny):
                         shed[0] += my_shed
                         errors[0] += my_err
 
-                threads = [threading.Thread(target=worker) for _ in range(clients_n)]
+                threads = [
+                    threading.Thread(target=worker, daemon=True)
+                    for _ in range(clients_n)
+                ]
                 for t in threads:
                     t.start()
                 for t in threads:

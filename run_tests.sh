@@ -72,10 +72,12 @@ for arg in "$@"; do
   fi
 done
 
-# static-analysis gate, two-phase (per-file walks + project-wide index):
-# jit purity/host-sync, retry & lock discipline, lock-order deadlock
-# detection, chaos-obs coverage, import hygiene, donation safety, the
-# metrics contract, and trace discipline (rule catalog: docs/analysis.md)
+# static-analysis gate, two-phase (per-file walks + project-wide index,
+# phase 1 parallel over min(4, cpu) workers): jit purity/host-sync, retry
+# & lock discipline, lock-order deadlock detection, chaos-obs coverage,
+# import hygiene, donation safety, the metrics contract, trace discipline,
+# commit discipline (crash consistency), thread lifecycle, and the env-lane
+# wiring (rule catalog: docs/analysis.md)
 python -m tosa
 
 export JAX_PLATFORMS=cpu

@@ -8,10 +8,12 @@ use from hook frameworks that pass filenames), then runs
     python -m tosa --changed <files...>
 
 which still indexes the default corpus — project-wide rules such as
-lock-order and metrics-contract need the whole program — but reports
-per-file findings only for the changed set. The phase-1 index cache
-(``tools/analyze/.tosa_cache.json``) means the corpus re-index only
-parses files whose content hash changed, so the hook stays fast.
+lock-order, commit-discipline and env-lane need the whole program — but
+reports per-file findings only for the changed set. The phase-1 index
+cache (``tools/analyze/.tosa_cache.json``) means the corpus re-index
+only parses files whose content hash changed, so the hook stays fast;
+``--jobs N`` is forwarded to ``python -m tosa`` for cold-cache runs
+(default: min(4, cpu count) worker processes).
 
 Install as a git hook with::
 
@@ -56,6 +58,16 @@ def main(argv=None):
     staged_only = "--staged" in argv
     if staged_only:
         argv.remove("--staged")
+    jobs = None
+    if "--jobs" in argv:
+        i = argv.index("--jobs")
+        try:
+            jobs = argv[i + 1]
+            int(jobs)
+        except (IndexError, ValueError):
+            print("tosa-precommit: --jobs needs an integer", file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
 
     if argv:
         # hook frameworks (and the tests) pass filenames directly
@@ -72,7 +84,10 @@ def main(argv=None):
         print("tosa-precommit: no changed python files")
         return 0
 
-    cmd = [sys.executable, "-m", "tosa", "--changed"] + changed
+    cmd = [sys.executable, "-m", "tosa", "--changed"]
+    if jobs is not None:
+        cmd += ["--jobs", jobs]
+    cmd += changed
     return subprocess.call(cmd, cwd=REPO_ROOT)
 
 

@@ -1191,6 +1191,17 @@ class _PreflightTask:
         checks["scratch"] = self._check_scratch()
         checks["loopback"] = self._check_loopback()
         checks["devices"] = self._check_devices()
+        # the local backend advertises the hosting executor's identity in
+        # the process env — a mismatch means the pin was not honored and
+        # this report would be attributed to the wrong host
+        lane = os.environ.get("TOS_LOCAL_EXECUTOR_ID")
+        if lane is not None:
+            checks["pinning"] = (
+                "ok" if str(executor_id) == lane
+                else "partition for executor {} ran on executor {}".format(
+                    executor_id, lane
+                )
+            )
         channel = self._check_channel(executor_id)
         if channel is not None:
             checks["channel"] = channel

@@ -245,7 +245,9 @@ class LocalStreamingContext:
     def __init__(self, sc, batch_interval=1.0):
         self.sc = sc
         self.batch_interval = batch_interval
-        self._queue = queue.Queue()
+        # bounded: a producer outpacing the batch ticker should block at the
+        # feed call, not grow the backlog without limit
+        self._queue = queue.Queue(maxsize=1024)
         self._streams = []
         self._stop_ev = threading.Event()
         self._thread = None
@@ -388,6 +390,8 @@ class LocalSparkContext:
                 logger.warning("killing unresponsive executor %s", proc.name)
                 proc.kill()
                 proc.join(timeout=5)
+        # collector re-checks _stop_ev every 0.2s result-queue timeout
+        self._collector.join(timeout=5)
         if cleanup and self._own_workdir:
             shutil.rmtree(self._workdir_root, ignore_errors=True)
 

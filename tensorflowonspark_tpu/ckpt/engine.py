@@ -44,7 +44,7 @@ import threading
 import time
 import weakref
 
-from tensorflowonspark_tpu import chaos, obs, resilience
+from tensorflowonspark_tpu import chaos, durable, obs, resilience
 from tensorflowonspark_tpu.ckpt import manifest as _manifest
 from tensorflowonspark_tpu.ckpt.snapshot import SnapshotBuffers
 
@@ -356,6 +356,9 @@ class AsyncCheckpointEngine:
         if os.path.isdir(final):  # re-save of the same step: replace
             shutil.rmtree(final, ignore_errors=True)
         os.rename(staging, final)
+        # restore-after-power-cut must see the publish: the step dir's
+        # rename is only durable once the checkpoint root's entry is
+        durable.fsync_dir(os.path.dirname(final))
         elapsed = time.monotonic() - t0
         obs.counter(
             "ckpt_write_seconds_total",

@@ -21,6 +21,8 @@ import logging
 import os
 import zlib
 
+from tensorflowonspark_tpu import durable
+
 logger = logging.getLogger(__name__)
 
 #: the commit marker file, written last inside the staging dir
@@ -75,6 +77,10 @@ def write_manifest(path, step=None, extra=None):
         f.flush()
         os.fsync(f.fileno())
     os.rename(tmp, os.path.join(path, MANIFEST_NAME))
+    # the rename is only durable once the directory entry is: a power cut
+    # after fsync(file) but before fsync(dir) can replay the directory
+    # without MANIFEST.json even though its bytes hit the platter
+    durable.fsync_dir(path)
     return manifest
 
 

@@ -49,7 +49,7 @@ import uuid
 
 import numpy as np
 
-from tensorflowonspark_tpu import chaos, obs
+from tensorflowonspark_tpu import chaos, durable, obs
 from tensorflowonspark_tpu.ckpt import manifest
 
 logger = logging.getLogger(__name__)
@@ -252,6 +252,10 @@ class SlabCache:
             self._rejects_c.inc()
             shutil.rmtree(stage, ignore_errors=True)
             return 0
+        # a generation that vanishes with a power cut is merely a cold
+        # cache, but a half-visible one would be re-staged under a new
+        # name while the old entry lingers — make the publish durable
+        durable.fsync_dir(os.path.dirname(final))
         ok, reason = manifest.verify(final)
         if not ok:
             logger.warning("slab cache: published generation failed verify (%s); dropping", reason)

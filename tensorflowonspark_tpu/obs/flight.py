@@ -45,6 +45,7 @@ import threading
 import time
 import zlib
 
+from tensorflowonspark_tpu import durable
 from tensorflowonspark_tpu.obs import registry as _registry
 
 #: env var naming the root directory all shards are written under; unset
@@ -162,6 +163,10 @@ class FlightRecorder:
         os.fsync(self._fh.fileno())
         self._fh.close()
         os.rename(self._seg_path(sealed=False), self._seg_path(sealed=True))
+        # the crash that the flight recorder exists for is exactly the one
+        # that loses an unfsynced directory entry: seal durably or the
+        # post-mortem merge sees a gap where the final segment was
+        durable.fsync_dir(self.shard_dir)
         self._seg_index += 1
         self._open_segment()
         self._prune_locked()

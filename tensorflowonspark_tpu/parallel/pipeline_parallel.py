@@ -211,7 +211,9 @@ class Pipeline1F1B:
         self._fwd = [None] * self.n_stages
         self._bwd = [None] * self.n_stages
         self._last_prog = None
-        self._jobs = queue_mod.Queue()
+        # bounded: a wedged comm thread should exert backpressure on the
+        # stage workers instead of accumulating device buffers in the queue
+        self._jobs = queue_mod.Queue(maxsize=max(8, 4 * self.n_stages))
         self._comm_worker = None
         self._comm_err = None
 
@@ -378,14 +380,18 @@ class Pipeline1F1B:
 
         workers = [
             threading.Thread(
-                target=_run_stage, args=(i,), name="pipeline-stage-{}".format(i)
+                target=_run_stage, args=(i,), name="pipeline-stage-{}".format(i),
+                daemon=True,  # a wedged XLA call must not pin interpreter exit
             )
             for i in range(P)
         ]
         for w in workers:
             w.start()
         for w in workers:
-            w.join()
+            # the error path unblocks neighbours, so every stage terminates;
+            # bounded join slices keep a wedged device call diagnosable
+            while w.is_alive():
+                w.join(timeout=60.0)
         for i, e in enumerate(errs):
             if e is not None:
                 raise RuntimeError("pipeline stage {} failed".format(i)) from e

@@ -71,7 +71,7 @@ import threading
 import time
 import zlib
 
-from tensorflowonspark_tpu import chaos, obs, resilience
+from tensorflowonspark_tpu import chaos, durable, obs, resilience
 from tensorflowonspark_tpu.obs import aggregate as obs_aggregate
 from tensorflowonspark_tpu.obs import registry as obs_registry
 
@@ -485,7 +485,9 @@ class MembershipRegistry:
                 # that dies mid-rename (see _commit_manifest_locked)
                 self._commit_manifest_locked(tear=True)
                 return
-        with open(os.path.join(self.journal_dir, JOURNAL_NAME), "a") as f:
+        jpath = os.path.join(self.journal_dir, JOURNAL_NAME)
+        creating = not os.path.exists(jpath)
+        with open(jpath, "a") as f:
             f.write(self._frame(payload))
             if record["op"] in _DURABLE_OPS:
                 f.flush()
@@ -494,6 +496,11 @@ class MembershipRegistry:
                     "registry_journal_commits_total",
                     help="durable membership journal/manifest commits",
                 ).inc()
+        if creating:
+            # the first append materializes journal.log itself; without a
+            # directory fsync a power cut can lose the file while the writer
+            # believed its fsynced records were safe
+            durable.fsync_dir(self.journal_dir)
         self._records_since_manifest += 1
         if self._records_since_manifest >= self._manifest_every or record["op"] == "epoch":
             self._commit_manifest_locked()
@@ -502,22 +509,6 @@ class MembershipRegistry:
     def _frame(payload):
         """One journal line: crc32-of-payload, space, payload, newline."""
         return "{:08x} {}\n".format(zlib.crc32(payload.encode("utf-8")) & 0xFFFFFFFF, payload)
-
-    @staticmethod
-    def _fsync_dir(path):
-        """Make a rename in ``path`` durable: fsync the directory entry the
-        same way file contents are fsynced (best-effort — some filesystems
-        refuse directory fds)."""
-        try:
-            fd = os.open(path, os.O_RDONLY)
-        except OSError:
-            return
-        try:
-            os.fsync(fd)
-        except OSError:
-            pass
-        finally:
-            os.close(fd)
 
     def _state_locked(self):
         return {
@@ -563,7 +554,7 @@ class MembershipRegistry:
         # make the rename itself durable before the truncation below can be:
         # otherwise a power loss may persist an empty journal next to the
         # OLD manifest, silently losing the folded-in transitions
-        self._fsync_dir(self.journal_dir)
+        durable.fsync_dir(self.journal_dir)
         try:
             self._manifest_stat = self._stat_manifest()
         except OSError:
@@ -846,6 +837,10 @@ class HeartbeatAggregator:
 
     def stop(self):
         self._stop.set()
+        if self._thread is not None:
+            # bounded: the poll loop re-checks the stop event every window
+            self._thread.join(timeout=self._window + 5.0)
+            self._thread = None
 
     def _run(self):
         from tensorflowonspark_tpu import TFManager

@@ -261,6 +261,9 @@ class Server:
                 pass
         except OSError:
             pass
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
     @property
     def stop_requested(self):

@@ -32,6 +32,8 @@ import os
 
 import cloudpickle
 
+from tensorflowonspark_tpu import durable
+
 logger = logging.getLogger(__name__)
 
 _BUILDER_FILE = "predict_builder.pkl"
@@ -74,7 +76,10 @@ def export_model(export_dir, predict_builder, params, model_state=None):
         tmp = os.path.join(export_dir, _WEIGHTS_NPZ + ".tmp")
         with open(tmp, "wb") as f:
             np.savez(f, **flat)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, os.path.join(export_dir, _WEIGHTS_NPZ))
+        durable.fsync_dir(export_dir)
         _remove_stale(export_dir, _WEIGHTS_FILE)
     else:
         logger.warning(
@@ -84,7 +89,10 @@ def export_model(export_dir, predict_builder, params, model_state=None):
         tmp = os.path.join(export_dir, _WEIGHTS_FILE + ".tmp")
         with open(tmp, "wb") as f:
             cloudpickle.dump(state, f)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, os.path.join(export_dir, _WEIGHTS_FILE))
+        durable.fsync_dir(export_dir)
         _remove_stale(export_dir, _WEIGHTS_NPZ)
     # a re-export into a legacy orbax-era bundle dir must not leave the old
     # checkpoint behind either: load_model prefers file lanes, but a later

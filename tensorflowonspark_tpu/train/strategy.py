@@ -481,7 +481,9 @@ class BucketedOverlap:
         self._apply_fn = None
         self._buckets = None  # list of (dtype, [leaf indices]) once shapes known
         self._treedef = None
-        self._jobs = queue.Queue()
+        # bounded: a stalled all-reduce worker should backpressure the
+        # dispatch loop, not let gradient buckets pile up unboundedly
+        self._jobs = queue.Queue(maxsize=32)
         self._worker = None
         self._worker_err = None
 

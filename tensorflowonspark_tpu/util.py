@@ -15,6 +15,8 @@ import multiprocessing
 import os
 import socket
 
+from tensorflowonspark_tpu import durable
+
 logger = logging.getLogger(__name__)
 
 _mp_spawn = multiprocessing.get_context("spawn")
@@ -104,7 +106,12 @@ def write_executor_state(state, cwd=None):
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(record, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    # reconnect-after-crash reads this record; a torn or vanished file
+    # strands later tasks without the running jax child's IPC address
+    durable.fsync_dir(os.path.dirname(path))
     return path
 
 

@@ -1,0 +1,397 @@
+"""Fixture tests for the commit-discipline and env-lane rules.
+
+Each finding class gets a bad fixture that fires and a good twin that
+stays clean; the docs-drift halves inject a ``docs/architecture.md``
+snippet through ``run_project_rule``'s ``docs`` mapping (without docs
+text those halves are skipped, which is itself asserted).
+"""
+
+import textwrap
+
+from tosa_testutil import LIB_PATH, run_project_rule
+from tosa import core
+
+
+def _src(s):
+    return textwrap.dedent(s).lstrip()
+
+
+# -- commit-discipline --------------------------------------------------------
+
+#: the full idiom: tmp write, file fsync, rename, parent-dir fsync
+GOOD_PUBLISH = _src("""
+    import os
+
+
+    def publish(path):
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("data")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        dirfd = os.open(os.path.dirname(path), os.O_RDONLY)
+        os.fsync(dirfd)
+        os.close(dirfd)
+""")
+
+#: docs row naming the good fixture's publish site with a verify consumer
+GOOD_PUBLISH_DOCS = _src("""
+    ### Durable commit points
+
+    | commit point | publishes | verified by |
+    |---|---|---|
+    | `tensorflowonspark_tpu/fixture_mod.py:publish` | the data file | reader re-parses and length-checks it |
+""")
+
+
+class TestCommitDiscipline:
+    def test_full_idiom_is_clean(self):
+        findings = run_project_rule("commit-discipline", {LIB_PATH: GOOD_PUBLISH})
+        assert findings == []
+
+    def test_rename_without_file_fsync_fires(self):
+        findings = run_project_rule("commit-discipline", {LIB_PATH: _src("""
+            import os
+
+
+            def publish(path):
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write("data")
+                os.replace(tmp, path)
+                dirfd = os.open(os.path.dirname(path), os.O_RDONLY)
+                os.fsync(dirfd)
+                os.close(dirfd)
+        """)})
+        assert len(findings) == 1
+        assert "without an fsync of the written file first" in findings[0].message
+
+    def test_rename_without_parent_dir_fsync_fires(self):
+        findings = run_project_rule("commit-discipline", {LIB_PATH: _src("""
+            import os
+
+
+            def publish(path):
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write("data")
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+        """)})
+        assert len(findings) == 1
+        assert "without fsyncing the parent directory" in findings[0].message
+
+    def test_fsync_through_called_helper_counts(self):
+        # provision flows through the call closure: the publish site calls
+        # a helper that does the file fsync / dir fsync on its behalf
+        findings = run_project_rule("commit-discipline", {LIB_PATH: _src("""
+            import os
+
+
+            def _flush(f):
+                f.flush()
+                os.fsync(f.fileno())
+
+
+            def _fsync_dir(path):
+                dirfd = os.open(path, os.O_RDONLY)
+                os.fsync(dirfd)
+                os.close(dirfd)
+
+
+            def publish(path):
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write("data")
+                    _flush(f)
+                os.replace(tmp, path)
+                _fsync_dir(os.path.dirname(path))
+        """)})
+        assert findings == []
+
+    def test_manifest_not_written_last_fires(self):
+        findings = run_project_rule("commit-discipline", {LIB_PATH: _src("""
+            import os
+
+            from tensorflowonspark_tpu.ckpt import manifest
+
+
+            def commit(root):
+                manifest.write_manifest(root)
+                with open(root + "/data.tmp", "w") as f:
+                    f.write("data")
+                    os.fsync(f.fileno())
+                os.replace(root + "/data.tmp", root + "/data")
+                dirfd = os.open(root, os.O_RDONLY)
+                os.fsync(dirfd)
+                os.close(dirfd)
+        """)})
+        assert len(findings) == 1
+        assert "must be written last" in findings[0].message
+
+    def test_manifest_written_last_is_clean(self):
+        findings = run_project_rule("commit-discipline", {LIB_PATH: _src("""
+            import os
+
+            from tensorflowonspark_tpu.ckpt import manifest
+
+
+            def commit(root):
+                with open(root + "/data.tmp", "w") as f:
+                    f.write("data")
+                    os.fsync(f.fileno())
+                manifest.write_manifest(root)
+                os.replace(root + "/data.tmp", root + "/data")
+                dirfd = os.open(root, os.O_RDONLY)
+                os.fsync(dirfd)
+                os.close(dirfd)
+        """)})
+        assert findings == []
+
+    def test_retention_rename_is_not_a_publish_site(self):
+        # a rename with no staging hint and no write intent before it is a
+        # retention shuffle, not a commit point — no findings even though
+        # it never fsyncs anything
+        findings = run_project_rule("commit-discipline", {LIB_PATH: _src("""
+            import os
+
+
+            def rotate(old, new):
+                os.rename(old, new)
+        """)})
+        assert findings == []
+
+    def test_chaos_guarded_torn_write_is_exempt(self):
+        # the deliberately-torn branch under an `if chaos...` test is the
+        # fault injection itself, not a durability bug
+        findings = run_project_rule("commit-discipline", {LIB_PATH: _src("""
+            import os
+
+            from tensorflowonspark_tpu import chaos
+
+
+            def publish(path):
+                tmp = path + ".tmp"
+                if chaos.should_tear("publish"):
+                    os.replace(tmp, path)
+                    return
+                with open(tmp, "w") as f:
+                    f.write("data")
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+                dirfd = os.open(os.path.dirname(path), os.O_RDONLY)
+                os.fsync(dirfd)
+                os.close(dirfd)
+        """)})
+        assert findings == []
+
+
+class TestCommitDisciplineDocs:
+    def test_documented_site_with_verify_consumer_is_clean(self):
+        findings = run_project_rule(
+            "commit-discipline",
+            {LIB_PATH: GOOD_PUBLISH},
+            docs={"docs/architecture.md": GOOD_PUBLISH_DOCS},
+        )
+        assert findings == []
+
+    def test_undocumented_publish_site_fires(self):
+        findings = run_project_rule(
+            "commit-discipline",
+            {LIB_PATH: GOOD_PUBLISH},
+            docs={"docs/architecture.md": "### Durable commit points\n\n(no rows)\n"},
+        )
+        assert len(findings) == 1
+        assert "missing from the Durable commit points table" in findings[0].message
+        assert findings[0].path == LIB_PATH
+
+    def test_stale_docs_row_fires_on_the_docs_file(self):
+        stale = GOOD_PUBLISH_DOCS + (
+            "| `tensorflowonspark_tpu/gone.py:publish` | nothing | nobody |\n"
+        )
+        findings = run_project_rule(
+            "commit-discipline",
+            {LIB_PATH: GOOD_PUBLISH},
+            docs={"docs/architecture.md": stale},
+        )
+        assert len(findings) == 1
+        assert "matches no publish site" in findings[0].message
+        assert findings[0].path == "docs/architecture.md"
+
+    def test_empty_verify_cell_fires(self):
+        no_verify = GOOD_PUBLISH_DOCS.replace(
+            "reader re-parses and length-checks it", "—"
+        )
+        findings = run_project_rule(
+            "commit-discipline",
+            {LIB_PATH: GOOD_PUBLISH},
+            docs={"docs/architecture.md": no_verify},
+        )
+        assert len(findings) == 1
+        assert "no verify-on-read consumer" in findings[0].message
+
+    def test_docs_half_skipped_without_docs_text(self):
+        # fixture runs with no docs mapping only get the code-side checks
+        findings = run_project_rule("commit-discipline", {LIB_PATH: GOOD_PUBLISH})
+        assert findings == []
+
+
+# -- env-lane -----------------------------------------------------------------
+
+WRITER = _src("""
+    import os
+
+
+    def launch(executor_id):
+        os.environ["TOS_FIXTURE_LANE"] = str(executor_id)
+""")
+
+READER = _src("""
+    import os
+
+
+    def attach():
+        return os.environ.get("TOS_FIXTURE_LANE")
+""")
+
+ENV_DOCS = _src("""
+    ### Env lanes
+
+    | name | kind | meaning |
+    |---|---|---|
+    | `TOS_FIXTURE_LANE` | lane | launch() → attach() |
+""")
+
+
+class TestEnvLane:
+    def test_wired_lane_is_clean(self):
+        findings = run_project_rule("env-lane", {
+            LIB_PATH: WRITER,
+            "tensorflowonspark_tpu/attach_mod.py": READER,
+        })
+        assert findings == []
+
+    def test_orphan_producer_fires(self):
+        findings = run_project_rule("env-lane", {LIB_PATH: WRITER})
+        assert len(findings) == 1
+        assert "never read anywhere" in findings[0].message
+
+    def test_off_lane_names_are_ignored(self):
+        findings = run_project_rule("env-lane", {LIB_PATH: _src("""
+            import os
+
+
+            def launch():
+                os.environ["SOME_OTHER_VAR"] = "1"
+        """)})
+        assert findings == []
+
+    def test_constant_name_resolves_across_modules(self):
+        # producer writes through a module constant; consumer from-imports
+        # the constant — both resolve to the same literal lane name
+        findings = run_project_rule("env-lane", {
+            LIB_PATH: _src("""
+                import os
+
+                LANE = "TOS_FIXTURE_LANE"
+
+
+                def launch(executor_id):
+                    os.environ[LANE] = str(executor_id)
+            """),
+            "tensorflowonspark_tpu/attach_mod.py": _src("""
+                import os
+
+                from tensorflowonspark_tpu.fixture_mod import LANE
+
+
+                def attach():
+                    return os.environ.get(LANE)
+            """),
+        })
+        assert findings == []
+
+    def test_module_level_read_counts_as_consumer(self):
+        # import-time defaults (`X = float(os.environ.get(...))`) are
+        # consumers too; without module-level scanning the writer would
+        # look like an orphan producer
+        findings = run_project_rule("env-lane", {
+            LIB_PATH: WRITER,
+            "tensorflowonspark_tpu/attach_mod.py": _src("""
+                import os
+
+                FIXTURE_LANE = os.environ.get("TOS_FIXTURE_LANE", "0")
+            """),
+        })
+        assert findings == []
+
+
+class TestEnvLaneDocs:
+    def test_documented_wired_lane_is_clean(self):
+        findings = run_project_rule(
+            "env-lane",
+            {LIB_PATH: WRITER, "tensorflowonspark_tpu/attach_mod.py": READER},
+            docs={"docs/architecture.md": ENV_DOCS},
+        )
+        assert findings == []
+
+    def test_undocumented_read_fires(self):
+        findings = run_project_rule(
+            "env-lane",
+            {LIB_PATH: WRITER, "tensorflowonspark_tpu/attach_mod.py": READER},
+            docs={"docs/architecture.md": "### Env lanes\n\n(no rows)\n"},
+        )
+        assert len(findings) == 1
+        assert "missing from the Env lanes table" in findings[0].message
+
+    def test_stale_docs_row_fires_on_the_docs_file(self):
+        stale = ENV_DOCS + "| `TOS_GONE_LANE` | knob | nothing uses this |\n"
+        findings = run_project_rule(
+            "env-lane",
+            {LIB_PATH: WRITER, "tensorflowonspark_tpu/attach_mod.py": READER},
+            docs={"docs/architecture.md": stale},
+        )
+        assert len(findings) == 1
+        assert "matches no read or write" in findings[0].message
+        assert findings[0].path == "docs/architecture.md"
+
+    def test_documented_lane_without_producer_fires(self):
+        # kind `lane` promises an in-code producer; a read-only name must
+        # be reclassified as a knob instead
+        findings = run_project_rule(
+            "env-lane",
+            {"tensorflowonspark_tpu/attach_mod.py": READER},
+            docs={"docs/architecture.md": ENV_DOCS},
+        )
+        assert len(findings) == 1
+        assert "documented as a produced lane but nothing" in findings[0].message
+
+    def test_knob_kind_needs_no_producer(self):
+        knob_docs = ENV_DOCS.replace("| lane |", "| knob |")
+        findings = run_project_rule(
+            "env-lane",
+            {"tensorflowonspark_tpu/attach_mod.py": READER},
+            docs={"docs/architecture.md": knob_docs},
+        )
+        assert findings == []
+
+
+class TestNewRulesSuppressionAndBaseline:
+    def test_inline_disable_silences_project_finding(self):
+        src = WRITER.replace(
+            'os.environ["TOS_FIXTURE_LANE"] = str(executor_id)',
+            'os.environ["TOS_FIXTURE_LANE"] = str(executor_id)'
+            "  # tosa: disable=env-lane -- fixture lane has no reader yet",
+        )
+        findings = run_project_rule("env-lane", {LIB_PATH: src}, keep_suppressed=True)
+        assert len(findings) == 1
+        assert findings[0].suppressed == "fixture lane has no reader yet"
+        assert core.gating(findings) == []
+
+    def test_baseline_grandfathers_project_finding(self):
+        findings = run_project_rule("env-lane", {LIB_PATH: WRITER})
+        assert len(core.gating(findings)) == 1
+        baseline = {findings[0].fingerprint: 1}
+        findings = core.apply_baseline(findings, baseline)
+        assert core.gating(findings) == []

@@ -84,12 +84,13 @@ class TestBaseline:
 
 
 class TestRegistry:
-    def test_all_ten_rules_registered(self):
+    def test_all_thirteen_rules_registered(self):
         assert set(ALL_CHECKERS) == {
             "jit-host-sync", "jit-purity", "retry-discipline",
             "lock-discipline", "lock-order", "chaos-obs-coverage",
             "import-hygiene", "donation-safety", "metrics-contract",
-            "trace-discipline",
+            "trace-discipline", "commit-discipline", "thread-lifecycle",
+            "env-lane",
         }
 
     def test_unknown_rule_fails_loudly(self):
@@ -280,7 +281,7 @@ class TestSelfRun:
     def test_repo_is_clean_under_all_rules(self):
         """The hard gate: the analyzer over its default targets (library,
         bench.py, scripts) finds nothing to report — every invariant the
-        ten rules encode holds in this repo, with an empty baseline."""
+        thirteen rules encode holds in this repo, with an empty baseline."""
         proc = _run_cli([])
         assert proc.returncode == 0, "\n" + proc.stdout + proc.stderr
 

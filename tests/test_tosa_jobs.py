@@ -1,0 +1,149 @@
+"""Parallel phase-1 tests: ``--jobs`` output parity with the serial
+path, cache interaction (cold parallel run populates it, warm run spawns
+no workers), and the pre-commit wrapper's ``--jobs`` forwarding."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+from tosa_testutil import REPO_ROOT
+from tosa import core, make_checkers
+
+
+def _src(s):
+    return textwrap.dedent(s).lstrip()
+
+
+def _library_paths():
+    lib = os.path.join(REPO_ROOT, "tensorflowonspark_tpu")
+    return sorted(
+        os.path.join(dirpath, name)
+        for dirpath, _, names in os.walk(lib)
+        for name in names
+        if name.endswith(".py")
+    )
+
+
+def _dicts(findings):
+    return [f.to_dict() for f in findings]
+
+
+class TestJobsParity:
+    def test_parallel_output_matches_serial_on_the_library(self):
+        paths = _library_paths()
+        assert len(paths) > 10
+        serial = core.analyze_project(paths, make_checkers(), root=REPO_ROOT, jobs=1)
+        parallel = core.analyze_project(paths, make_checkers(), root=REPO_ROOT, jobs=4)
+        # byte-identical merge: same findings in the same order
+        assert _dicts(parallel) == _dicts(serial)
+
+    def test_cold_parallel_run_populates_cache_warm_spawns_no_workers(
+        self, tmp_path, monkeypatch
+    ):
+        paths = _library_paths()
+        cache_path = str(tmp_path / "cache.json")
+        t0 = time.monotonic()
+        cold = core.analyze_project(
+            paths, make_checkers(), root=REPO_ROOT, cache_path=cache_path, jobs=4
+        )
+        cold_s = time.monotonic() - t0
+        assert os.path.exists(cache_path)
+
+        # a warm run must not touch the pool at all: every file is a cache
+        # hit, so a booby-trapped executor proves no workers are spawned
+        import concurrent.futures
+
+        def _boom(*a, **kw):
+            raise AssertionError("warm run spawned a process pool")
+
+        monkeypatch.setattr(concurrent.futures, "ProcessPoolExecutor", _boom)
+        t0 = time.monotonic()
+        warm = core.analyze_project(
+            paths, make_checkers(), root=REPO_ROOT, cache_path=cache_path, jobs=4
+        )
+        warm_s = time.monotonic() - t0
+        assert _dicts(warm) == _dicts(cold)
+        # warm replays cached summaries: no parse, no fork; generous
+        # margin so CI jitter doesn't flake the assertion
+        assert warm_s < max(cold_s * 0.6, 0.25), (cold_s, warm_s)
+
+    def test_cache_written_by_parallel_run_serves_a_serial_run(self, tmp_path):
+        paths = _library_paths()
+        cache_path = str(tmp_path / "cache.json")
+        cold = core.analyze_project(
+            paths, make_checkers(), root=REPO_ROOT, cache_path=cache_path, jobs=4
+        )
+        warm = core.analyze_project(
+            paths, make_checkers(), root=REPO_ROOT, cache_path=cache_path, jobs=1
+        )
+        assert _dicts(warm) == _dicts(cold)
+
+
+BAD_SLEEP = _src("""
+    import time
+
+    def wait(q):
+        while q.empty():
+            time.sleep(0.1)
+""")
+
+
+class TestJobsCLI:
+    def _corpus(self, tmp_path):
+        (tmp_path / "bad.py").write_text(BAD_SLEEP)
+        for i in range(6):
+            (tmp_path / "mod{}.py".format(i)).write_text(
+                "def f{}():\n    return {}\n".format(i, i)
+            )
+        return tmp_path
+
+    def _run(self, tmp_path, extra):
+        return subprocess.run(
+            [sys.executable, "-m", "tosa", "--json", "--root", str(tmp_path),
+             "--baseline", str(tmp_path / "bl.json"), str(tmp_path)] + extra,
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+
+    def test_jobs_flag_is_output_invariant(self, tmp_path):
+        self._corpus(tmp_path)
+        serial = self._run(tmp_path, ["--jobs", "1"])
+        parallel = self._run(tmp_path, ["--jobs", "3"])
+        assert serial.returncode == 1, serial.stderr
+        assert parallel.returncode == 1, parallel.stderr
+        assert json.loads(parallel.stdout) == json.loads(serial.stdout)
+
+    def test_precommit_forwards_jobs(self, tmp_path):
+        # the wrapper strips `--jobs N` from its own argv and re-emits it
+        # on the `python -m tosa --changed` command line
+        bad = tmp_path / "bad.py"
+        bad.write_text(BAD_SLEEP)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts", "tosa_precommit.py"),
+             "--jobs", "2", str(bad)],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "retry-discipline" in proc.stdout
+
+    def test_precommit_rejects_malformed_jobs(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(BAD_SLEEP)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts", "tosa_precommit.py"),
+             "--jobs", "lots", str(bad)],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 2
+        assert "--jobs needs an integer" in proc.stderr

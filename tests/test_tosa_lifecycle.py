@@ -1,0 +1,435 @@
+"""Fixture tests for the thread-lifecycle rule: reachable stop signals,
+join discipline, and bounded hand-off queues."""
+
+import textwrap
+
+from tosa_testutil import LIB_PATH, run_project_rule
+from tosa import core
+
+
+def _src(s):
+    return textwrap.dedent(s).lstrip()
+
+
+class TestStopSignal:
+    def test_stopless_while_true_on_spawned_thread_fires(self):
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import threading
+
+
+            class Pump:
+                def start(self):
+                    self._thread = threading.Thread(target=self._run, daemon=True)
+                    self._thread.start()
+
+                def _run(self):
+                    while True:
+                        do_work()
+
+                def stop(self):
+                    self._thread.join(timeout=5.0)
+        """)})
+        assert len(findings) == 1
+        assert "checks no stop signal" in findings[0].message
+
+    def test_event_wait_loop_is_clean(self):
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import threading
+
+
+            class Pump:
+                def start(self):
+                    self._stop = threading.Event()
+                    self._thread = threading.Thread(target=self._run, daemon=True)
+                    self._thread.start()
+
+                def _run(self):
+                    while True:
+                        if self._stop.wait(0.1):
+                            return
+                        do_work()
+
+                def stop(self):
+                    self._stop.set()
+                    self._thread.join(timeout=5.0)
+        """)})
+        assert findings == []
+
+    def test_queue_sentinel_exit_is_clean(self):
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import queue
+            import threading
+
+
+            class Pump:
+                def start(self):
+                    self._q = queue.Queue(maxsize=64)
+                    self._thread = threading.Thread(target=self._run, daemon=True)
+                    self._thread.start()
+
+                def _run(self):
+                    while True:
+                        item = self._q.get()
+                        if item is None:
+                            return
+                        handle(item)
+
+                def stop(self):
+                    self._q.put(None)
+                    self._thread.join(timeout=5.0)
+        """)})
+        assert findings == []
+
+    def test_stop_flag_guarded_exit_is_clean(self):
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import threading
+
+
+            class Pump:
+                def start(self):
+                    self._closed = False
+                    self._thread = threading.Thread(target=self._run, daemon=True)
+                    self._thread.start()
+
+                def _run(self):
+                    while True:
+                        if self._closed:
+                            break
+                        do_work()
+
+                def stop(self):
+                    self._closed = True
+                    self._thread.join(timeout=5.0)
+        """)})
+        assert findings == []
+
+    def test_stopless_loop_one_call_down_fires(self):
+        # the spawn target delegates to a helper; the helper's loop still
+        # runs on the spawned thread (targets expand one call level)
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import threading
+
+
+            def _drain_forever(q):
+                while True:
+                    handle(q.get())
+
+
+            def launch(q):
+                threading.Thread(target=_run, args=(q,), daemon=True).start()
+
+
+            def _run(q):
+                _drain_forever(q)
+        """)})
+        assert len(findings) == 1
+        assert "checks no stop signal" in findings[0].message
+        assert "_drain_forever" in findings[0].message
+
+    def test_generator_pull_loop_is_exempt(self):
+        # `while True: yield ...` is driven by its consumer; the stop
+        # signal lives in the caller, not the loop body
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import threading
+
+
+            def _waves(q):
+                while True:
+                    yield q.get()
+
+
+            def _run(q):
+                for wave in _waves(q):
+                    if wave is None:
+                        return
+                    handle(wave)
+
+
+            def launch(q):
+                threading.Thread(target=_run, args=(q,), daemon=True).start()
+        """)})
+        assert findings == []
+
+    def test_submit_target_gets_stop_check_but_not_join_discipline(self):
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            class Pump:
+                def start(self, pool):
+                    pool.submit(self._run)
+
+                def _run(self):
+                    while True:
+                        do_work()
+        """)})
+        # one stop-signal finding; no drop-the-handle finding — executor
+        # shutdown owns submit lifetimes
+        assert len(findings) == 1
+        assert "checks no stop signal" in findings[0].message
+
+
+class TestJoinDiscipline:
+    def test_self_handle_never_joined_fires(self):
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import threading
+
+
+            class Pump:
+                def start(self):
+                    self._stop = threading.Event()
+                    self._thread = threading.Thread(target=self._run, daemon=True)
+                    self._thread.start()
+
+                def _run(self):
+                    while True:
+                        if self._stop.is_set():
+                            return
+                        do_work()
+
+                def stop(self):
+                    self._stop.set()
+        """)})
+        assert len(findings) == 1
+        assert "never joined on any shutdown path" in findings[0].message
+
+    def test_self_handle_untimed_join_fires(self):
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import threading
+
+
+            class Pump:
+                def start(self):
+                    self._stop = threading.Event()
+                    self._thread = threading.Thread(target=self._run, daemon=True)
+                    self._thread.start()
+
+                def _run(self):
+                    while True:
+                        if self._stop.is_set():
+                            return
+                        do_work()
+
+                def stop(self):
+                    self._stop.set()
+                    self._thread.join()
+        """)})
+        assert len(findings) == 1
+        assert "only joined without a timeout" in findings[0].message
+
+    def test_timer_cancelled_on_shutdown_is_clean(self):
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import threading
+
+
+            class Rearm:
+                def arm(self):
+                    self._timer = threading.Timer(5.0, self._fire)
+                    self._timer.start()
+
+                def _fire(self):
+                    do_work()
+
+                def stop(self):
+                    self._timer.cancel()
+        """)})
+        assert findings == []
+
+    def test_dropped_handle_without_daemon_fires(self):
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import threading
+
+
+            def launch(ev):
+                threading.Thread(target=_run, args=(ev,)).start()
+
+
+            def _run(ev):
+                while True:
+                    if ev.is_set():
+                        return
+                    do_work()
+        """)})
+        assert len(findings) == 1
+        assert "drops the handle and is not daemon=True" in findings[0].message
+
+    def test_dropped_handle_with_daemon_is_clean(self):
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import threading
+
+
+            def launch(ev):
+                threading.Thread(target=_run, args=(ev,), daemon=True).start()
+
+
+            def _run(ev):
+                while True:
+                    if ev.is_set():
+                        return
+                    do_work()
+        """)})
+        assert findings == []
+
+    def test_local_handle_untimed_join_fires(self):
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import threading
+
+
+            def run_once(ev):
+                t = threading.Thread(target=_work, args=(ev,))
+                t.start()
+                t.join()
+
+
+            def _work(ev):
+                do_work()
+        """)})
+        assert len(findings) == 1
+        assert "joined without a timeout" in findings[0].message
+
+    def test_local_handle_leaked_without_daemon_fires(self):
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import threading
+
+
+            def run_once(ev):
+                t = threading.Thread(target=_work, args=(ev,))
+                t.start()
+
+
+            def _work(ev):
+                do_work()
+        """)})
+        assert len(findings) == 1
+        assert "neither joined with a timeout" in findings[0].message
+
+    def test_sliced_timed_join_is_clean(self):
+        # `while t.is_alive(): t.join(timeout=...)` keeps wait-forever
+        # semantics while satisfying the timed-join rule — the fix pattern
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import threading
+
+
+            def run_once(ev):
+                t = threading.Thread(target=_work, args=(ev,))
+                t.start()
+                while t.is_alive():
+                    t.join(timeout=60.0)
+
+
+            def _work(ev):
+                do_work()
+        """)})
+        assert findings == []
+
+    def test_post_hoc_daemon_set_amends_the_spawn(self):
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import threading
+
+
+            def run_once(ev):
+                t = threading.Thread(target=_work, args=(ev,))
+                t.daemon = True
+                t.start()
+
+
+            def _work(ev):
+                do_work()
+        """)})
+        assert findings == []
+
+
+class TestBoundedHandoff:
+    UNBOUNDED = _src("""
+        import queue
+        import threading
+
+
+        class Feeder:
+            def __init__(self):
+                self._q = queue.Queue()
+                self._t = threading.Thread(target=self._drain, daemon=True)
+                self._t.start()
+
+            def _drain(self):
+                while True:
+                    item = self._q.get()
+                    if item is None:
+                        return
+                    handle(item)
+
+            def close(self):
+                self._q.put(None)
+                self._t.join(timeout=5.0)
+    """)
+
+    def test_unbounded_queue_with_spawned_consumer_fires(self):
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: self.UNBOUNDED})
+        assert len(findings) == 1
+        assert "unbounded Queue()" in findings[0].message
+        assert "Feeder._drain" in findings[0].message
+
+    def test_bounded_queue_is_clean(self):
+        bounded = self.UNBOUNDED.replace("queue.Queue()", "queue.Queue(maxsize=64)")
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: bounded})
+        assert findings == []
+
+    def test_multiprocessing_queue_is_exempt(self):
+        # mp queues have different bounding semantics; the rule only
+        # covers `queue.Queue`
+        mp = self.UNBOUNDED.replace("import queue", "import multiprocessing as queue")
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: mp})
+        assert findings == []
+
+    def test_unconsumed_unbounded_queue_is_clean(self):
+        # no spawned thread drains it — buffering in the owner's own
+        # thread is not a hand-off hazard
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: _src("""
+            import queue
+
+
+            class Buffer:
+                def __init__(self):
+                    self._q = queue.Queue()
+
+                def push(self, item):
+                    self._q.put(item)
+
+                def pop(self):
+                    return self._q.get()
+        """)})
+        assert findings == []
+
+
+class TestSuppressionAndBaseline:
+    BAD = _src("""
+        import threading
+
+
+        def launch(ev):
+            threading.Thread(target=_run, args=(ev,)).start()
+
+
+        def _run(ev):
+            while True:
+                if ev.is_set():
+                    return
+                do_work()
+    """)
+
+    def test_inline_disable_silences_with_reason(self):
+        src = self.BAD.replace(
+            "threading.Thread(target=_run, args=(ev,)).start()",
+            "threading.Thread(target=_run, args=(ev,)).start()"
+            "  # tosa: disable=thread-lifecycle -- fixture leaks on purpose",
+        )
+        findings = run_project_rule(
+            "thread-lifecycle", {LIB_PATH: src}, keep_suppressed=True
+        )
+        assert len(findings) == 1
+        assert findings[0].suppressed == "fixture leaks on purpose"
+        assert core.gating(findings) == []
+
+    def test_baseline_grandfathers_one_occurrence(self):
+        findings = run_project_rule("thread-lifecycle", {LIB_PATH: self.BAD})
+        assert len(core.gating(findings)) == 1
+        baseline = {findings[0].fingerprint: 1}
+        findings = core.apply_baseline(findings, baseline)
+        assert core.gating(findings) == []

@@ -80,6 +80,13 @@ def build_parser():
         help="disable the content-hash phase-1 index cache",
     )
     p.add_argument(
+        "--jobs",
+        type=int,
+        default=None,
+        help="phase-1 worker processes (default: min(4, cpu count); "
+        "1 = serial; cache hits never spawn workers)",
+    )
+    p.add_argument(
         "--cache",
         help="index cache path (default: <root>/{})".format(
             CACHE_RELPATH.replace(os.sep, "/")
@@ -167,8 +174,10 @@ def main(argv=None):
         if not os.path.isdir(os.path.dirname(cache_path)):
             cache_path = None
 
+    jobs = args.jobs if args.jobs and args.jobs > 0 else min(4, os.cpu_count() or 1)
     findings = core.analyze_project(
-        paths, checkers, root=root, cache_path=cache_path, report_only=report_only
+        paths, checkers, root=root, cache_path=cache_path, report_only=report_only,
+        jobs=jobs,
     )
 
     baseline_path = args.baseline or os.path.join(root, BASELINE_RELPATH)

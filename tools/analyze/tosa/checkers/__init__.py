@@ -6,7 +6,9 @@ suppression validation all read from this one table.
 """
 
 from .chaos_obs import ChaosObsChecker
+from .commit_discipline import CommitDisciplineChecker
 from .donation_safety import DonationSafetyChecker
+from .env_lane import EnvLaneChecker
 from .import_hygiene import ImportHygieneChecker
 from .jit_host_sync import JitHostSyncChecker
 from .jit_purity import JitPurityChecker
@@ -14,6 +16,7 @@ from .lock_discipline import LockDisciplineChecker
 from .lock_order import LockOrderChecker
 from .metrics_contract import MetricsContractChecker
 from .retry_discipline import RetryDisciplineChecker
+from .thread_lifecycle import ThreadLifecycleChecker
 from .trace_discipline import TraceDisciplineChecker
 
 ALL_CHECKERS = {
@@ -29,6 +32,9 @@ ALL_CHECKERS = {
         DonationSafetyChecker,
         MetricsContractChecker,
         TraceDisciplineChecker,
+        CommitDisciplineChecker,
+        ThreadLifecycleChecker,
+        EnvLaneChecker,
     )
 }
 

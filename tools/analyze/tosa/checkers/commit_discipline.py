@@ -1,0 +1,214 @@
+"""commit-discipline: every atomic-publish site commits durably.
+
+The repo's crash-consistency convention (docs/architecture.md, "Durable
+commit points") is the tmp-write → fsync → rename idiom: write into a
+staging path, ``os.fsync`` the data, ``os.rename``/``os.replace`` onto
+the final name, then ``os.fsync`` the parent directory so the *directory
+entry* survives a power cut — and when a manifest marks the commit, the
+manifest is written last, after every data file it describes.
+
+This rule runs over the phase-1 index's ordered per-function commit-I/O
+event streams (``fsio``: write-opens with staging hints, file/dir fsyncs,
+renames, ``write_manifest``/``verify`` calls — chaos-guarded torn-write
+branches excluded at extraction). A rename qualifies as a **publish
+site** when its source is staging-hinted or the function shows write/
+fsync intent before it; retention shuffles and generic path helpers that
+merely receive a path argument do not qualify.
+
+Per publish site:
+
+1. **fsync-before-rename** — the published bytes are fsynced (directly,
+   via ``write_manifest``, or via a called helper) before the rename;
+   otherwise the rename can land an empty/partial file after a crash.
+2. **parent-dir fsync** — after the rename, the parent directory is
+   fsynced (the ``os.open(dir, O_RDONLY)`` + ``os.fsync`` idiom, a
+   ``*fsync_dir*`` helper, or a callee that does either); otherwise the
+   rename itself may be lost on power failure even though both files
+   were durable.
+3. **manifest-written-last** — no data file is write-opened between the
+   last ``write_manifest`` call and the publish rename: the manifest is
+   the commit marker and must describe bytes that already exist.
+4. **Docs drift, both directions** — every publish site has a row in the
+   "Durable commit points" table of ``docs/architecture.md`` naming its
+   verify-on-read consumer, and every documented row matches a real
+   publish site in the scanned code.
+
+The docs half is skipped when the scan has no docs text (fixture runs
+can inject one through the index's ``docs`` mapping).
+"""
+
+import re
+
+from .. import core
+from ..index import TMP_NAME_HINTS
+
+DOC_RELPATH = "docs/architecture.md"
+
+#: a Durable-commit-points row: | `relpath:qual` | publishes | verified by |
+ROW_RE = re.compile(
+    r"^\s*\|\s*`(?P<site>[A-Za-z0-9_./]+\.py:[A-Za-z0-9_.<>]+)`\s*\|"
+    r"\s*(?P<what>[^|]*)\|\s*(?P<verify>[^|]*)\|"
+)
+
+
+def _has_tmp_hint(name):
+    return any(h in name.lower() for h in TMP_NAME_HINTS)
+
+
+class CommitDisciplineChecker(core.Checker):
+    rule = "commit-discipline"
+    description = (
+        "tmp-write/fsync/rename publish sites must fsync the file and its "
+        "parent directory, write the manifest last, and match the docs "
+        "Durable-commit-points inventory"
+    )
+    interests = ()
+    project = True
+
+    def check_project(self, index, run):
+        provides_f, provides_d = self._closures(index)
+        sites = {}  # "relpath:qual" -> (relpath, line)
+        for relpath, qual, fsum in index.functions():
+            fsio = fsum.get("fsio", ())
+            if not fsio:
+                continue
+            calls_at = [
+                (e[3], e[1])
+                for e in fsum.get("events", ())
+                if e[0] == "call" and e[3] is not None
+            ]
+            cls = fsum.get("class")
+            var_types = fsum.get("var_types", {})
+            for i, (op, a, b, line) in enumerate(fsio):
+                if op != "rename":
+                    continue
+                before = fsio[:i]
+                qualifies = _has_tmp_hint(a) or any(
+                    e[0] in ("openw", "fsyncf", "manifest") for e in before
+                )
+                if not qualifies:
+                    continue
+                sites.setdefault("{}:{}".format(relpath, qual), (relpath, line))
+                dst = "`{}`".format(b) if b else "the final path"
+                if not any(e[0] in ("fsyncf", "manifest") for e in before) and not any(
+                    cl < line and self._resolves_to(index, relpath, cls, ref, var_types, provides_f)
+                    for cl, ref in calls_at
+                ):
+                    run.report(
+                        self,
+                        relpath,
+                        line,
+                        "publish rename onto {} in {}() without an fsync of the "
+                        "written file first — after a crash the rename can land "
+                        "an empty or partial file under the committed "
+                        "name".format(dst, qual),
+                    )
+                after_d = any(
+                    e[0] == "fsyncd" for e in fsio[i + 1:]
+                ) or any(
+                    cl >= line and self._resolves_to(index, relpath, cls, ref, var_types, provides_d)
+                    for cl, ref in calls_at
+                )
+                if not after_d:
+                    run.report(
+                        self,
+                        relpath,
+                        line,
+                        "publish rename onto {} in {}() without fsyncing the "
+                        "parent directory afterwards — the directory entry is "
+                        "not durable, so recovery can miss a commit that the "
+                        "caller already observed as complete".format(dst, qual),
+                    )
+                manifests = [j for j, e in enumerate(before) if e[0] == "manifest"]
+                if manifests and any(
+                    e[0] == "openw" for e in before[manifests[-1] + 1:]
+                ):
+                    run.report(
+                        self,
+                        relpath,
+                        line,
+                        "data file write-opened after write_manifest() but before "
+                        "the publish rename in {}() — the manifest is the commit "
+                        "marker and must be written last, after every byte it "
+                        "describes".format(qual),
+                    )
+        self._check_docs(index, run, sites)
+
+    # -- fsync call closures -------------------------------------------------
+
+    def _closures(self, index):
+        """Fixpoint sets of functions that (transitively) perform a file
+        fsync / a parent-directory fsync somewhere in their body."""
+        provides_f = set()
+        provides_d = set()
+        for relpath, qual, fsum in index.functions():
+            ops = {e[0] for e in fsum.get("fsio", ())}
+            if "fsyncf" in ops or "manifest" in ops:
+                provides_f.add((relpath, qual))
+            if "fsyncd" in ops:
+                provides_d.add((relpath, qual))
+        for _ in range(4):  # call chains in the tree are shallow
+            changed = False
+            for relpath, qual, fsum in index.functions():
+                cls = fsum.get("class")
+                var_types = fsum.get("var_types", {})
+                for ref in fsum.get("calls", ()):
+                    target = index.resolve_call(relpath, cls, ref, var_types)
+                    if target is None:
+                        continue
+                    if target in provides_f and (relpath, qual) not in provides_f:
+                        provides_f.add((relpath, qual))
+                        changed = True
+                    if target in provides_d and (relpath, qual) not in provides_d:
+                        provides_d.add((relpath, qual))
+                        changed = True
+            if not changed:
+                break
+        return provides_f, provides_d
+
+    def _resolves_to(self, index, relpath, cls, ref, var_types, closure):
+        target = index.resolve_call(relpath, cls, ref, var_types)
+        return target is not None and target in closure
+
+    # -- docs drift ----------------------------------------------------------
+
+    def _check_docs(self, index, run, sites):
+        doc = index.docs.get(DOC_RELPATH)
+        if doc is None:
+            return  # fixture runs without docs skip the drift half
+        documented = {}  # site -> (verify cell, doc line)
+        for lineno, text in enumerate(doc.splitlines(), start=1):
+            m = ROW_RE.match(text)
+            if m:
+                documented.setdefault(
+                    m.group("site"), (m.group("verify").strip(), lineno)
+                )
+        for site in sorted(sites):
+            relpath, line = sites[site]
+            if site not in documented:
+                run.report(
+                    self,
+                    relpath,
+                    line,
+                    "publish site `{}` is missing from the Durable commit "
+                    "points table in {} — add a row naming its verify-on-read "
+                    "consumer".format(site, DOC_RELPATH),
+                )
+            elif documented[site][0] in ("", "—", "-"):
+                run.report(
+                    self,
+                    relpath,
+                    line,
+                    "publish site `{}` has a Durable-commit-points row with no "
+                    "verify-on-read consumer — every commit point needs a "
+                    "reader that detects a torn or stale publish".format(site),
+                )
+        for site in sorted(set(documented) - set(sites)):
+            run.report(
+                self,
+                DOC_RELPATH,
+                documented[site][1],
+                "Durable-commit-points row `{}` matches no publish site in the "
+                "scanned code — stale row or a commit path the index can no "
+                "longer see".format(site),
+            )

@@ -1,0 +1,170 @@
+"""env-lane: the TOS_*/TF_CONFIG environment lanes stay wired and
+documented end to end.
+
+Environment variables are this system's cross-process control lanes
+(PAPER.md L3/L4): the reservation REG, child spawns, worker forks,
+replica launches and bench attaches all pass state through ``TOS_*``
+names. A lane with a producer and no consumer is dead weight; a consumer
+with no producer silently reads its default forever; an undocumented
+name is invisible to operators.
+
+The rule runs over the phase-1 index's env-op facts — ``os.environ``
+reads and writes, ``os.getenv``, ``setdefault``, lane-keyed ``.get`` on
+env dicts handed between processes, and lane-keyed dict literals built
+for child environments. Names may be literals or module-level constants
+(``TRACE_ENV = "TOS_TRACE_ID"``), resolved across modules through the
+import table.
+
+Checks:
+
+1. **Orphan producer** — a name written somewhere but read nowhere in
+   the scanned code: the lane's consumer was removed or never built.
+2. **Docs drift, both directions** — every name read in code has a row
+   in the "Env lanes" table of ``docs/architecture.md``; every row
+   matches a name actually read or written in code.
+3. **Lane without producer** — a row whose kind is ``lane`` (internally
+   produced, as opposed to a user-set ``knob``) must have at least one
+   in-code write on some spawn/propagation path.
+
+The docs half (2, 3) is skipped when the scan has no docs text (fixture
+runs can inject one through the index's ``docs`` mapping).
+"""
+
+import re
+
+from .. import core
+from ..index import ENV_LANE_PREFIXES
+
+DOC_RELPATH = "docs/architecture.md"
+
+#: an Env-lanes row: | `NAME` | knob|lane | producer → consumer |
+ROW_RE = re.compile(
+    r"^\s*\|\s*`(?P<name>(?:TOS_|TF_CONFIG)[A-Za-z0-9_]*)`\s*\|\s*(?P<kind>knob|lane)\b"
+)
+
+
+def _on_lane(name):
+    return any(name.startswith(p) for p in ENV_LANE_PREFIXES)
+
+
+class EnvLaneChecker(core.Checker):
+    rule = "env-lane"
+    description = (
+        "TOS_*/TF_CONFIG env vars must have both ends of their lane in "
+        "code and a row in the docs Env-lanes table"
+    )
+    interests = ()
+    project = True
+
+    def check_project(self, index, run):
+        reads = {}   # name -> (relpath, line, qual) first site
+        writes = {}
+        for relpath, qual, fsum in index.functions():
+            for kind, key, line in fsum.get("env_ops", ()):
+                name = self._resolve_key(index, relpath, key)
+                if name is None or not _on_lane(name):
+                    continue
+                book = reads if kind == "read" else writes
+                book.setdefault(name, (relpath, line, qual))
+        for relpath, mod in index.modules.items():
+            for kind, key, line in mod.get("env_ops", ()):
+                name = self._resolve_key(index, relpath, key)
+                if name is None or not _on_lane(name):
+                    continue
+                book = reads if kind == "read" else writes
+                book.setdefault(name, (relpath, line, "<module>"))
+        for name in sorted(set(writes) - set(reads)):
+            relpath, line, qual = writes[name]
+            run.report(
+                self,
+                relpath,
+                line,
+                "env var `{}` is produced in {}() but never read anywhere in "
+                "the scanned code — the lane has no consumer; wire up the "
+                "reader or remove the write".format(name, qual),
+            )
+        self._check_docs(index, run, reads, writes)
+
+    # -- constant resolution -------------------------------------------------
+
+    def _resolve_key(self, index, relpath, key, depth=0):
+        """A recorded env key to its literal name: literals pass through,
+        ``$NAME``/``$alias.NAME`` resolve through module consts and the
+        import table (cross-module, bounded depth)."""
+        if not key.startswith("$"):
+            return key
+        if depth > 4 or relpath not in index.modules:
+            return None
+        mod = index.modules[relpath]
+        ref = key[1:]
+        if "." not in ref:
+            const = mod.get("consts", {}).get(ref)
+            if const is not None:
+                if const[0] == "lit":
+                    return const[1]
+                return self._resolve_dotted(index, relpath, const[1], depth + 1)
+            # from-import of a constant: `from .flight import TRACE_DIR_ENV`
+            target = mod.get("imports", {}).get(ref)
+            if target and "." in target:
+                mod_part, cname = target.rsplit(".", 1)
+                rel2 = index.module_path(mod_part)
+                if rel2:
+                    return self._resolve_key(index, rel2, "$" + cname, depth + 1)
+            return None
+        return self._resolve_dotted(index, relpath, ref, depth + 1)
+
+    def _resolve_dotted(self, index, relpath, dotted, depth):
+        head, _, tail = dotted.partition(".")
+        if not tail or "." in tail:
+            return None
+        mod = index.modules[relpath]
+        target = mod.get("imports", {}).get(head)
+        if not target:
+            return None
+        rel2 = index.module_path(target)
+        if rel2 is None:
+            return None
+        return self._resolve_key(index, rel2, "$" + tail, depth)
+
+    # -- docs drift ----------------------------------------------------------
+
+    def _check_docs(self, index, run, reads, writes):
+        doc = index.docs.get(DOC_RELPATH)
+        if doc is None:
+            return  # fixture runs without docs skip the drift half
+        documented = {}  # name -> (kind, doc line)
+        for lineno, text in enumerate(doc.splitlines(), start=1):
+            m = ROW_RE.match(text)
+            if m:
+                documented.setdefault(m.group("name"), (m.group("kind"), lineno))
+        for name in sorted(set(reads) - set(documented)):
+            relpath, line, qual = reads[name]
+            run.report(
+                self,
+                relpath,
+                line,
+                "env var `{}` is read in {}() but missing from the Env lanes "
+                "table in {} — add a row saying who sets it (knob = operator, "
+                "lane = produced in code)".format(name, qual, DOC_RELPATH),
+            )
+        for name in sorted(documented):
+            kind, doc_line = documented[name]
+            if name not in reads and name not in writes:
+                run.report(
+                    self,
+                    DOC_RELPATH,
+                    doc_line,
+                    "Env-lanes row `{}` matches no read or write in the "
+                    "scanned code — stale row or a lane the index can no "
+                    "longer see".format(name),
+                )
+            elif kind == "lane" and name not in writes:
+                run.report(
+                    self,
+                    DOC_RELPATH,
+                    doc_line,
+                    "env var `{}` is documented as a produced lane but nothing "
+                    "in the scanned code writes it — its readers only ever see "
+                    "their defaults; fix the producer or reclassify it as a "
+                    "knob".format(name),
+                )

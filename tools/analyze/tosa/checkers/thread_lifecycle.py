@@ -1,0 +1,280 @@
+"""thread-lifecycle: every spawned thread can be told to stop, and is
+either joined with a timeout or explicitly daemonized.
+
+Three invariant groups over the phase-1 index's spawn/join/loop/queue
+facts (``threading.Thread``/``Timer`` constructions, ``executor.submit``
+calls, ``.join`` sites, ``while True`` loops, class queue attributes):
+
+1. **Reachable stop signal** — a ``while True`` loop that runs on a
+   spawned thread (the spawn target itself, or anything it calls one
+   level down) must check a stop signal in its body: an
+   ``Event.is_set()``/``.wait()``, a queue-sentinel exit
+   (``if item is None: return``), or an exit guarded by a stop-named
+   flag. A loop with no reachable stop signal can only be killed with
+   the process.
+2. **Join discipline** — a spawn handle stored on ``self`` must be
+   joined *with a timeout* (or, for a ``Timer``, cancelled) on some
+   shutdown path of its class; a handle stored in a local must be
+   timed-joined in the same function unless the thread is a daemon; a
+   spawn whose handle is dropped on the floor must be ``daemon=True``.
+   Untimed ``.join()`` on a known spawn handle is always flagged — an
+   untimed join turns one wedged worker into a wedged shutdown.
+3. **Bounded hand-off** — an unbounded ``queue.Queue()`` stored on
+   ``self`` and consumed by a spawned thread of the same class is
+   flagged: with no ``maxsize`` a stalled consumer grows the heap
+   instead of applying backpressure to producers. (``multiprocessing``
+   queues are exempt; their bounding semantics differ.)
+
+``executor.submit`` targets get the stop-signal check (group 1) but not
+join discipline — executor shutdown owns those lifetimes.
+"""
+
+from .. import core
+
+#: ctor tails (from var_types) that mark a local as a thread handle
+_HANDLE_CTORS = ("Thread", "Timer")
+
+
+class ThreadLifecycleChecker(core.Checker):
+    rule = "thread-lifecycle"
+    description = (
+        "spawned threads need a reachable stop signal, a join with "
+        "timeout (or daemon status), and bounded hand-off queues"
+    )
+    interests = ()
+    project = True
+
+    def check_project(self, index, run):
+        spawned = self._spawn_targets(index)
+        self._check_loops(index, run, spawned)
+        self._check_joins(index, run)
+        self._check_queues(index, run)
+
+    # -- group 1: stop signals -----------------------------------------------
+
+    def _spawn_targets(self, index):
+        """Resolved (relpath, qual) set of functions that run on a spawned
+        thread: direct targets plus everything they call, one level."""
+        entries = set()
+        for relpath, qual, fsum in index.functions():
+            cls = fsum.get("class")
+            var_types = fsum.get("var_types", {})
+            for kind, target, daemon, stored, line in fsum.get("spawns", ()):
+                resolved = self._resolve_target(
+                    index, relpath, qual, cls, target, var_types
+                )
+                if resolved is not None:
+                    entries.add(resolved)
+        expanded = set(entries)
+        for relpath, qual in entries:
+            fsum = index.modules[relpath]["functions"][qual]
+            cls = fsum.get("class")
+            var_types = fsum.get("var_types", {})
+            for ref in fsum.get("calls", ()):
+                target = index.resolve_call(relpath, cls, ref, var_types)
+                if target is not None:
+                    expanded.add(target)
+        return expanded
+
+    def _resolve_target(self, index, relpath, qual, cls, target, var_types):
+        if not target:
+            return None
+        mod = index.modules[relpath]
+        if "." not in target:
+            nested = "{}.<{}>".format(qual, target)
+            if nested in mod["functions"]:
+                return (relpath, nested)
+        return index.resolve_call(relpath, cls, target, var_types)
+
+    def _check_loops(self, index, run, spawned):
+        for relpath, qual in sorted(spawned):
+            fsum = index.modules[relpath]["functions"][qual]
+            for line, has_stop in fsum.get("wloops", ()):
+                if not has_stop:
+                    run.report(
+                        self,
+                        relpath,
+                        line,
+                        "`while True` loop in {}() runs on a spawned thread but "
+                        "checks no stop signal — no Event.is_set()/.wait(), no "
+                        "queue sentinel, no stop flag; the thread can only be "
+                        "killed with the process".format(qual),
+                    )
+
+    # -- group 2: join discipline --------------------------------------------
+
+    def _check_joins(self, index, run):
+        for relpath in sorted(index.modules):
+            mod = index.modules[relpath]
+            # class-wide view: which self attrs are timed-joined/cancelled
+            class_joins = {}  # cls -> {attr: max timedness}
+            class_cancels = {}  # cls -> set of cancelled attrs
+            for qual, fsum in mod["functions"].items():
+                cls = fsum.get("class")
+                if not cls:
+                    continue
+                for recv, timed, _line in fsum.get("thread_joins", ()):
+                    if recv.startswith("self.") and recv.count(".") == 1:
+                        attr = recv[5:]
+                        cur = class_joins.setdefault(cls, {})
+                        cur[attr] = max(cur.get(attr, -1), timed)
+                for ref in fsum.get("calls", ()):
+                    if ref.startswith("self.") and ref.endswith(".cancel"):
+                        class_cancels.setdefault(cls, set()).add(
+                            ref[5:].rsplit(".", 1)[0]
+                        )
+            for qual, fsum in sorted(mod["functions"].items()):
+                cls = fsum.get("class")
+                local_joins = {}  # var -> max timedness in this function
+                for recv, timed, _line in fsum.get("thread_joins", ()):
+                    if "." not in recv:
+                        local_joins[recv] = max(local_joins.get(recv, -1), timed)
+                for kind, target, daemon, stored, line in fsum.get("spawns", ()):
+                    if kind == "submit":
+                        continue
+                    label = "`{}()`".format(target) if target else "thread"
+                    if not stored:
+                        if daemon != 1:
+                            run.report(
+                                self,
+                                relpath,
+                                line,
+                                "spawn of {} in {}() drops the handle and is not "
+                                "daemon=True — it can neither be joined nor be "
+                                "ignored at interpreter exit; pass daemon=True "
+                                "or keep the handle and join it with a "
+                                "timeout".format(label, qual),
+                            )
+                        continue
+                    if stored.startswith("self."):
+                        attr = stored[5:]
+                        timed = class_joins.get(cls, {}).get(attr, -1)
+                        cancelled = attr in class_cancels.get(cls, set())
+                        if kind == "timer" and cancelled:
+                            continue
+                        if timed < 0 and not cancelled:
+                            run.report(
+                                self,
+                                relpath,
+                                line,
+                                "thread handle `{}` spawned in {}() is never "
+                                "joined on any shutdown path of {} — add a "
+                                "join(timeout=...) so close() can't leak the "
+                                "worker".format(stored, qual, cls),
+                            )
+                        elif timed == 0 and not cancelled:
+                            run.report(
+                                self,
+                                relpath,
+                                line,
+                                "thread handle `{}` spawned in {}() is only "
+                                "joined without a timeout — a wedged worker "
+                                "turns shutdown into a hang; join with a "
+                                "timeout".format(stored, qual),
+                            )
+                    else:  # var:<name>
+                        var = stored[4:]
+                        timed = local_joins.get(var, -1)
+                        if timed == 0:
+                            run.report(
+                                self,
+                                relpath,
+                                line,
+                                "thread `{}` spawned in {}() is joined without a "
+                                "timeout — a wedged worker hangs the caller "
+                                "forever; join with a timeout".format(var, qual),
+                            )
+                        elif timed < 0 and daemon != 1:
+                            run.report(
+                                self,
+                                relpath,
+                                line,
+                                "thread `{}` spawned in {}() is neither joined "
+                                "with a timeout in this function nor daemon=True "
+                                "— the handle dies with the scope but the "
+                                "thread does not".format(var, qual),
+                            )
+                # untimed joins on known handles not covered by a spawn record
+                var_types = fsum.get("var_types", {})
+                for recv, timed, jline in fsum.get("thread_joins", ()):
+                    if timed:
+                        continue
+                    ctor = var_types.get(recv, "")
+                    if ctor.split(".")[-1] in _HANDLE_CTORS and not any(
+                        s[3] == "var:" + recv for s in fsum.get("spawns", ())
+                    ):
+                        run.report(
+                            self,
+                            relpath,
+                            jline,
+                            "untimed join on thread handle `{}` in {}() — a "
+                            "wedged worker hangs the caller forever; join with "
+                            "a timeout".format(recv, qual),
+                        )
+
+    # -- group 3: bounded hand-off -------------------------------------------
+
+    def _check_queues(self, index, run):
+        for relpath in sorted(index.modules):
+            mod = index.modules[relpath]
+            for cls_name, cls in sorted(mod["classes"].items()):
+                unbounded = {
+                    attr: info
+                    for attr, info in cls.get("queue_attrs", {}).items()
+                    if isinstance(info, dict)
+                    and not info.get("bounded")
+                    and info.get("mod") == "queue"
+                }
+                if not unbounded:
+                    continue
+                consumers = self._class_spawn_reach(index, relpath, cls_name)
+                for attr in sorted(unbounded):
+                    ref = "{}.{}".format(cls_name, attr)
+                    hit = next(
+                        (
+                            q
+                            for _rp, q in consumers
+                            if ref
+                            in index.modules[_rp]["functions"][q].get(
+                                "queue_gets", ()
+                            )
+                        ),
+                        None,
+                    )
+                    if hit is not None:
+                        run.report(
+                            self,
+                            relpath,
+                            unbounded[attr].get("line", 1),
+                            "unbounded Queue() `self.{}` of {} is consumed by "
+                            "spawned thread {}() — give it a maxsize so a "
+                            "stalled consumer applies backpressure instead of "
+                            "growing the heap without bound".format(
+                                attr, cls_name, hit
+                            ),
+                        )
+
+    def _class_spawn_reach(self, index, relpath, cls_name):
+        """Functions reachable from this class's spawn targets (targets
+        plus one level of calls) — the code that runs on its threads."""
+        mod = index.modules[relpath]
+        entries = set()
+        for qual, fsum in mod["functions"].items():
+            if fsum.get("class") != cls_name:
+                continue
+            var_types = fsum.get("var_types", {})
+            for kind, target, _d, _s, _l in fsum.get("spawns", ()):
+                resolved = self._resolve_target(
+                    index, relpath, qual, cls_name, target, var_types
+                )
+                if resolved is not None:
+                    entries.add(resolved)
+        expanded = set(entries)
+        for rp, q in entries:
+            fsum = index.modules[rp]["functions"][q]
+            var_types = fsum.get("var_types", {})
+            for ref in fsum.get("calls", ()):
+                target = index.resolve_call(rp, fsum.get("class"), ref, var_types)
+                if target is not None:
+                    expanded.add(target)
+        return expanded

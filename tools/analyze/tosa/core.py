@@ -269,7 +269,32 @@ def analyze_files(paths, checkers, root=None):
     return analyze_project(paths, checkers, root=root)
 
 
-def analyze_project(paths, checkers, root=None, cache_path=None, report_only=None):
+def _phase1_worker(task):
+    """Process-pool phase 1 for one file: parse, walk the per-file rules,
+    summarize. Returns a picklable ``(relpath, summary, finding dicts,
+    encoded suppressions)`` tuple — the exact payload the content-hash
+    cache stores, which is also the proof this is safe to parallelize:
+    every cross-file fact a phase-2 rule needs already flows through the
+    summary (the cache-hit path never re-walks a file either)."""
+    relpath, source, rule_names = task
+    from . import index as _index
+    from .checkers import make_checkers
+
+    run = RunContext()
+    proj = _index.ProjectIndex()
+    findings = analyze_source(
+        source, relpath, make_checkers(rule_names), run=run, project=proj
+    )
+    return (
+        relpath,
+        proj.modules.get(relpath),
+        [f.to_dict() for f in findings],
+        _encode_suppressions(run.suppressions.get(relpath, {})),
+    )
+
+
+def analyze_project(paths, checkers, root=None, cache_path=None, report_only=None,
+                    jobs=None):
     """Two-phase analysis: build the project index (phase 1) while walking
     per-file checkers, then run project-wide rules against it (phase 2).
 
@@ -278,6 +303,11 @@ def analyze_project(paths, checkers, root=None, cache_path=None, report_only=Non
     of being re-parsed. ``report_only`` (a set of relpaths) restricts
     *per-file* findings to those files — the ``--changed`` / pre-commit
     mode — while project-wide rules still see the whole index.
+
+    ``jobs`` > 1 fans phase 1 out over a process pool (cache hits stay in
+    the parent — a warm run spawns no workers). Output is byte-identical
+    to the serial path: results merge back in input order, and phase 2
+    always runs serially in the parent.
     """
     from . import index as _index
 
@@ -286,6 +316,10 @@ def analyze_project(paths, checkers, root=None, cache_path=None, report_only=Non
     run = RunContext()
     proj = _index.ProjectIndex(root=root)
     cache = _index.load_cache(cache_path, [c.rule for c in checkers]) if cache_path else None
+    rule_names = [c.rule for c in checkers]
+    parallel = jobs is not None and jobs > 1
+    records = []  # in path order: ("done", [Finding]) | ("miss", task idx)
+    tasks = []    # (relpath, digest, source, reported)
     for path in paths:
         relpath = os.path.relpath(path, root).replace(os.sep, "/")
         reported = report_only is None or relpath in report_only
@@ -294,9 +328,9 @@ def analyze_project(paths, checkers, root=None, cache_path=None, report_only=Non
                 data = f.read()
         except OSError as e:
             if reported:
-                findings.append(
+                records.append(("done", [
                     Finding("parse-error", relpath, 1, 0, "unreadable: {}".format(e))
-                )
+                ]))
             continue
         digest = _index.content_hash(data)
         if cache is not None:
@@ -305,15 +339,21 @@ def analyze_project(paths, checkers, root=None, cache_path=None, report_only=Non
                 proj.add_summary(relpath, entry["summary"])
                 run.suppressions[relpath] = _decode_suppressions(entry["suppressions"])
                 if reported:
-                    findings.extend(Finding.from_dict(d) for d in entry["findings"])
+                    records.append(
+                        ("done", [Finding.from_dict(d) for d in entry["findings"]])
+                    )
                 continue
         try:
             source = data.decode("utf-8")
         except UnicodeDecodeError as e:
             if reported:
-                findings.append(
+                records.append(("done", [
                     Finding("parse-error", relpath, 1, 0, "undecodable: {}".format(e))
-                )
+                ]))
+            continue
+        if parallel:
+            records.append(("miss", len(tasks)))
+            tasks.append((relpath, digest, source, reported))
             continue
         file_findings = analyze_source(
             source, relpath, checkers, run=run, path=path, project=proj
@@ -327,7 +367,31 @@ def analyze_project(paths, checkers, root=None, cache_path=None, report_only=Non
                 _encode_suppressions(run.suppressions.get(relpath, {})),
             )
         if reported:
-            findings.extend(file_findings)
+            records.append(("done", file_findings))
+    resolved = {}
+    if tasks:
+        from concurrent.futures import ProcessPoolExecutor
+
+        with ProcessPoolExecutor(max_workers=min(jobs, len(tasks))) as pool:
+            results = list(pool.map(
+                _phase1_worker,
+                [(rp, src, rule_names) for rp, _d, src, _r in tasks],
+                chunksize=max(1, len(tasks) // (4 * jobs)),
+            ))
+        for (relpath, digest, _src, reported), (rp, summary, f_dicts, supp_enc) in zip(
+            tasks, results
+        ):
+            proj.add_summary(relpath, summary)
+            run.suppressions[relpath] = _decode_suppressions(supp_enc)
+            if cache is not None:
+                cache.put(relpath, digest, summary, f_dicts, supp_enc)
+            if reported:
+                resolved[relpath] = [Finding.from_dict(d) for d in f_dicts]
+    for kind, payload in records:
+        if kind == "done":
+            findings.extend(payload)
+        else:
+            findings.extend(resolved.get(tasks[payload][0], ()))
     proj.load_docs()
     for checker in checkers:
         check_project = getattr(checker, "check_project", None)

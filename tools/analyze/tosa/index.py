@@ -48,6 +48,14 @@ POOL_METHODS = {"append", "extend", "add", "insert", "appendleft"}
 #: calls that publish/merge a private registry into the cluster view
 PUBLISH_CALLS = {"accumulate_to_channel", "publish_to_channel", "SnapshotPublisher"}
 
+#: env-var name prefixes that form the cross-process communication lanes
+#: (reservation REG, child spawn, worker fork, replica launch, bench attach)
+ENV_LANE_PREFIXES = ("TOS_", "TF_CONFIG")
+#: name fragments that mark a path expression as a staging/temporary file
+TMP_NAME_HINTS = ("tmp", "temp", "stag", "part", "pending", "scratch")
+#: name fragments in an `if` test that signal a loop's shutdown check
+STOP_NAME_HINTS = ("stop", "shut", "clos", "done", "exit", "cancel", "running", "alive")
+
 
 def module_name(relpath):
     """Dotted module name for a repo-relative path."""
@@ -61,6 +69,113 @@ def module_name(relpath):
 
 def _literal_str(node):
     return node.value if isinstance(node, ast.Constant) and isinstance(node.value, str) else None
+
+
+def _env_key(node):
+    """An env-var key expression as a literal name, a ``$``-prefixed
+    constant reference (resolved by phase 2 against module consts), or
+    None when dynamic (f-strings, concatenation)."""
+    lit = _literal_str(node)
+    if lit is not None:
+        return lit
+    ref = dotted_name(node)
+    if ref is not None:
+        return "$" + ref
+    return None
+
+
+def _is_env_lane_literal(name):
+    """True for a literal env-var name on the checked lanes."""
+    return any(name.startswith(p) for p in ENV_LANE_PREFIXES)
+
+
+def _name_has_tmp_hint(expr):
+    """True when a path expression mentions a staging/temp name anywhere
+    (variable names, attribute tails, or string literal fragments)."""
+    for node in ast.walk(expr):
+        text = None
+        if isinstance(node, ast.Name):
+            text = node.id
+        elif isinstance(node, ast.Attribute):
+            text = node.attr
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            text = node.value
+        if text and any(h in text.lower() for h in TMP_NAME_HINTS):
+            return True
+    return False
+
+
+def _name_has_dir_hint(expr):
+    """True when a path expression names a directory (``dirname(...)``,
+    ``self.root``, ``parent`` — the dir-fsync half of the commit idiom)."""
+    for node in ast.walk(expr):
+        text = None
+        if isinstance(node, ast.Name):
+            text = node.id
+        elif isinstance(node, ast.Attribute):
+            text = node.attr
+        if text and any(h in text.lower() for h in ("dir", "root", "parent", "folder")):
+            return True
+    return False
+
+
+def _is_chaos_test(test):
+    """True when an ``if`` test consults the chaos plane — the guarded
+    branch is a deliberately-torn write path, not a durability bug."""
+    for node in ast.walk(test):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            d = dotted_name(node)
+            if d and (d == "chaos" or d.startswith("chaos.")):
+                return True
+    return False
+
+
+def _compare_is_none(node):
+    """True for a ``x is None`` / ``x == None`` comparison node."""
+    return (
+        isinstance(node, ast.Compare)
+        and any(isinstance(op, (ast.Is, ast.Eq)) for op in node.ops)
+        and any(
+            isinstance(c, ast.Constant) and c.value is None for c in node.comparators
+        )
+    )
+
+
+def _body_has_exit(stmts):
+    for s in stmts:
+        for n in ast.walk(s):
+            if isinstance(n, (ast.Return, ast.Break)):
+                return True
+            if isinstance(n, ast.Raise):
+                return True
+    return False
+
+
+def _while_true_has_stop(body):
+    """Does a ``while True`` body check a reachable stop signal?
+
+    Recognized: ``Event.is_set()``/``.wait()`` anywhere; a queue-sentinel
+    exit (``if item is None: return/break``); or an exit guarded by a test
+    naming a stop-hint attribute (``if self._closed: break``)."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                d = dotted_name(node.func) or ""
+                if d.split(".")[-1] in ("is_set", "wait"):
+                    return True
+            if isinstance(node, ast.If):
+                exits = _body_has_exit(node.body) or _body_has_exit(node.orelse)
+                if not exits:
+                    continue
+                for sub in ast.walk(node.test):
+                    if _compare_is_none(sub):
+                        return True
+                    if isinstance(sub, (ast.Name, ast.Attribute)):
+                        d = dotted_name(sub) or ""
+                        tail = d.split(".")[-1].lower()
+                        if any(h in tail for h in STOP_NAME_HINTS):
+                            return True
+    return False
 
 
 def _donate_positions(call):
@@ -106,10 +221,18 @@ class _FunctionExtractor(ast.NodeVisitor):
             "registry_vars": [],  # [var, line]
             "registry_published": [],  # var names reaching a publish call
             "registry_escapes": [],    # var names passed/stored elsewhere
+            "env_ops": [],        # [kind("read"|"write"), key, line]
+            "spawns": [],         # [kind, target, daemon(1/0/-1), stored, line]
+            "thread_joins": [],   # [recv, timed(1/0), line]
+            "wloops": [],         # [line, has_stop(1/0)] (`while True` only)
+            "fsio": [],           # [op, a, b, line] ordered commit-I/O events
         }
         self._held = []  # stack of lock ids currently held (with-blocks)
         self._var_types = {}  # local var -> ctor ref (`w = Worker()`)
         self.summary["var_types"] = self._var_types
+        self._chaos_guard = 0  # >0 inside an `if chaos...` torn-write branch
+        self._dirfds = set()   # locals bound from os.open(dir, O_RDONLY)
+        self._var_spawn = {}   # local var -> spawn record (daemon post-sets)
 
     # -- lock identity -------------------------------------------------------
 
@@ -184,6 +307,20 @@ class _FunctionExtractor(ast.NodeVisitor):
             return
         if isinstance(stmt, ast.While):
             self._expr_uses(stmt.test)
+            if (
+                isinstance(stmt.test, ast.Constant)
+                and stmt.test.value
+                # generator pull-loops (`while True: yield ...`) are driven
+                # by their consumer; the stop signal lives in the caller
+                and not any(
+                    isinstance(n, (ast.Yield, ast.YieldFrom))
+                    for s in stmt.body
+                    for n in ast.walk(s)
+                )
+            ):
+                self.summary["wloops"].append(
+                    [stmt.lineno, 1 if _while_true_has_stop(stmt.body) else 0]
+                )
             for s in stmt.body:
                 self._stmt(s)
             for s in stmt.orelse:
@@ -191,8 +328,13 @@ class _FunctionExtractor(ast.NodeVisitor):
             return
         if isinstance(stmt, ast.If):
             self._expr_uses(stmt.test)
+            chaos_branch = _is_chaos_test(stmt.test)
+            if chaos_branch:
+                self._chaos_guard += 1
             for s in stmt.body:
                 self._stmt(s)
+            if chaos_branch:
+                self._chaos_guard -= 1
             for s in stmt.orelse:
                 self._stmt(s)
             return
@@ -281,9 +423,29 @@ class _FunctionExtractor(ast.NodeVisitor):
         self._expr_uses(stmt.value)
         value = stmt.value
         kind = self._classify(value)
+        # spawn storage: `self.t = Thread(...)` / `t = Thread(...)` marks
+        # the spawn record so join discipline knows where the handle lives
+        if isinstance(value, ast.Call) and self.summary["spawns"]:
+            ctor = dotted_name(value.func) or ""
+            if ctor.split(".")[-1] in SPAWN_CTORS:
+                rec = self.summary["spawns"][-1]
+                if rec[4] == value.lineno and not rec[3]:
+                    tgt0 = stmt.targets[0]
+                    tname = dotted_name(tgt0)
+                    if tname and tname.startswith("self.") and tname.count(".") == 1:
+                        rec[3] = tname
+                    elif isinstance(tgt0, ast.Name):
+                        rec[3] = "var:" + tgt0.id
+                        self._var_spawn[tgt0.id] = rec
         # pooling sinks: storing into an attribute or attribute-subscript
         for tgt in stmt.targets:
             if isinstance(tgt, ast.Attribute):
+                # `t.daemon = True` after the ctor amends the spawn record
+                if tgt.attr == "daemon":
+                    base = root_name(tgt)
+                    rec = self._var_spawn.get(base) if base else None
+                    if rec is not None and isinstance(value, ast.Constant):
+                        rec[2] = 1 if value.value else 0
                 tname = dotted_name(tgt) or tgt.attr
                 for v in self._value_vars(kind):
                     ev.append(["psink", v, stmt.lineno,
@@ -292,6 +454,7 @@ class _FunctionExtractor(ast.NodeVisitor):
                     if v not in self.summary["registry_escapes"]:
                         self.summary["registry_escapes"].append(v)
             elif isinstance(tgt, ast.Subscript):
+                self._env_subscript(tgt, "write")
                 base = root_name(tgt)
                 if isinstance(tgt.value, ast.Attribute):
                     tname = dotted_name(tgt.value) or "container"
@@ -318,6 +481,27 @@ class _FunctionExtractor(ast.NodeVisitor):
                 tail = ctor.split(".")[-1]
                 if tail == "Registry":
                     self.summary["registry_vars"].append([name, lineno])
+                if ctor == "os.open" and (
+                    any(
+                        isinstance(n, ast.Attribute) and n.attr == "O_DIRECTORY"
+                        for a in value.args
+                        for n in ast.walk(a)
+                    )
+                    or (
+                        any(
+                            isinstance(n, ast.Attribute) and n.attr == "O_RDONLY"
+                            for a in value.args
+                            for n in ast.walk(a)
+                        )
+                        and (
+                            "dir" in name.lower()
+                            or (value.args and _name_has_dir_hint(value.args[0]))
+                        )
+                    )
+                ):
+                    # `dirfd = os.open(dirpath, os.O_RDONLY)`: fsync(dirfd)
+                    # below is a directory-entry fsync, not a data-file fsync
+                    self._dirfds.add(name)
         if kind[0] == "jitdon":
             ev.append(["jitdon", name, kind[1], lineno])
             return
@@ -445,6 +629,25 @@ class _FunctionExtractor(ast.NodeVisitor):
         if held is not None:
             self.summary["puts_under"].append([held, ref, call.lineno, blocking])
 
+    def _env_subscript(self, node, kind):
+        """``<recv>[KEY]`` access where either the receiver is ``environ``
+        or the key is a literal on a checked env lane."""
+        recv = dotted_name(node.value) or ""
+        key = _env_key(node.slice)
+        if key is None:
+            return
+        is_environ = recv == "environ" or recv.endswith(".environ")
+        if is_environ or (not key.startswith("$") and _is_env_lane_literal(key)):
+            self.summary["env_ops"].append([kind, key, node.lineno])
+
+    def _env_op(self, kind, key, line):
+        if key is not None:
+            self.summary["env_ops"].append([kind, key, line])
+
+    def _fsio(self, op, a, b, line):
+        if self._chaos_guard == 0:
+            self.summary["fsio"].append([op, a or "", b or "", line])
+
     def _expr_uses(self, expr):
         """Record name uses, calls, metric registrations and sanitizers
         anywhere inside an expression (in source order)."""
@@ -452,6 +655,19 @@ class _FunctionExtractor(ast.NodeVisitor):
         for node in ast.walk(expr):
             if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
                 ev.append(["use", node.id, node.lineno])
+            elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+                self._env_subscript(node, "read")
+            elif isinstance(node, ast.Dict):
+                # env dict literals handed to a spawn/propagation path
+                # (`child_env = {TRACE_ENV: tid, ...}`) are lane producers
+                for k in node.keys:
+                    if k is None:
+                        continue
+                    key = _env_key(k)
+                    if key is not None and (
+                        key.startswith("$") or _is_env_lane_literal(key)
+                    ):
+                        self._env_op("write", key, k.lineno)
             elif (
                 isinstance(node, ast.Attribute)
                 and node.attr == "writeable"
@@ -491,6 +707,9 @@ class _FunctionExtractor(ast.NodeVisitor):
                     self.summary["joins_under"].append([held, call.lineno, has_timeout])
             if tail in ("put", "put_nowait", "get", "get_nowait"):
                 self._queue_op(call, tail, held)
+        self._lifecycle_call(call, name, tail)
+        self._env_call(call, name, tail)
+        self._fsio_call(call, name, tail)
         # metric registrations: <recv>.counter("name", ...)
         if tail in ("counter", "gauge", "histogram") and isinstance(call.func, ast.Attribute):
             recv = dotted_name(call.func.value)
@@ -510,6 +729,102 @@ class _FunctionExtractor(ast.NodeVisitor):
                 if isinstance(a, ast.Name):
                     if a.id not in self.summary["registry_escapes"]:
                         self.summary["registry_escapes"].append(a.id)
+
+    def _lifecycle_call(self, call, name, tail):
+        """Thread spawns and thread joins (thread-lifecycle facts)."""
+        if tail in SPAWN_CTORS or tail == "submit":
+            kind = {"Thread": "thread", "Timer": "timer"}.get(tail, "submit")
+            cand = None
+            if kind == "submit" and call.args:
+                cand = call.args[0]
+            elif kind == "timer" and len(call.args) > 1:
+                cand = call.args[1]
+            for kw in call.keywords:
+                if kw.arg in ("target", "function"):
+                    cand = kw.value
+            target = dotted_name(cand) if cand is not None else None
+            daemon = -1
+            for kw in call.keywords:
+                if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                    daemon = 1 if kw.value.value else 0
+            self.summary["spawns"].append(
+                [kind, target or "", daemon, "", call.lineno]
+            )
+        elif (
+            tail == "join"
+            and isinstance(call.func, ast.Attribute)
+            and all(kw.arg == "timeout" for kw in call.keywords)
+            and (
+                not call.args
+                or (
+                    len(call.args) == 1
+                    and isinstance(call.args[0], ast.Constant)
+                    and isinstance(call.args[0].value, (int, float))
+                )
+            )
+        ):
+            recv = dotted_name(call.func.value)
+            if recv is not None:
+                timed = bool(call.args) or any(
+                    kw.arg == "timeout"
+                    and not (
+                        isinstance(kw.value, ast.Constant) and kw.value.value is None
+                    )
+                    for kw in call.keywords
+                )
+                self.summary["thread_joins"].append(
+                    [recv, 1 if timed else 0, call.lineno]
+                )
+
+    def _env_call(self, call, name, tail):
+        """Env-lane reads/writes through call syntax."""
+        recv = (
+            dotted_name(call.func.value)
+            if isinstance(call.func, ast.Attribute)
+            else None
+        ) or ""
+        is_environ = recv == "environ" or recv.endswith(".environ")
+        key = _env_key(call.args[0]) if call.args else None
+        if name == "os.getenv" or (tail == "getenv" and not recv):
+            self._env_op("read", key, call.lineno)
+        elif tail == "get" and key is not None:
+            # environ.get always counts; `.get` on any other receiver only
+            # for lane-shaped keys (env dicts handed between processes)
+            if is_environ or key.startswith("$") or _is_env_lane_literal(key):
+                self._env_op("read", key, call.lineno)
+        elif tail == "setdefault" and key is not None:
+            if is_environ or (not key.startswith("$") and _is_env_lane_literal(key)):
+                self._env_op("write", key, call.lineno)
+
+    def _fsio_call(self, call, name, tail):
+        """Ordered commit-I/O events (commit-discipline facts)."""
+        if name == "os.fsync":
+            arg = call.args[0] if call.args else None
+            if isinstance(arg, ast.Name) and arg.id in self._dirfds:
+                self._fsio("fsyncd", "", "", call.lineno)
+            else:
+                self._fsio("fsyncf", "", "", call.lineno)
+        elif "fsync_dir" in tail or tail == "dirsync":
+            self._fsio("fsyncd", "", "", call.lineno)
+        elif name in ("os.rename", "os.replace") and len(call.args) >= 2:
+            src, dst = call.args[0], call.args[1]
+            self._fsio(
+                "rename",
+                dotted_name(src) or ("tmp" if _name_has_tmp_hint(src) else ""),
+                dotted_name(dst) or "",
+                call.lineno,
+            )
+        elif tail == "write_manifest":
+            self._fsio("manifest", "", "", call.lineno)
+        elif tail == "verify":
+            self._fsio("verify", "", "", call.lineno)
+        elif name == "open" and len(call.args) >= 2:
+            mode = _literal_str(call.args[1])
+            if mode and ("w" in mode or "x" in mode):
+                hint = 1 if _name_has_tmp_hint(call.args[0]) else 0
+                self._fsio("openw", str(hint), "", call.lineno)
+        elif tail in ("NamedTemporaryFile", "mkstemp"):
+            self._fsio("openw", "1", "", call.lineno)
 
     def _recv_kind(self, recv):
         """'global' when the receiver is the shared obs registry module,
@@ -573,7 +888,21 @@ class _ModuleExtractor:
 
     def _module_level(self):
         donators = {}
+        consts = {}
         for node in self.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                cname = node.targets[0].id
+                lit = _literal_str(node.value)
+                if lit is not None:
+                    consts[cname] = ["lit", lit]
+                else:
+                    ref = dotted_name(node.value)
+                    if ref:
+                        consts[cname] = ["ref", ref]
             if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
                 ctor = dotted_name(node.value.func) or ""
                 tail = ctor.split(".")[-1]
@@ -589,6 +918,52 @@ class _ModuleExtractor:
                                 donators[tgt.id] = pos
         self.summary["module_locks"] = sorted(self.module_locks)
         self.summary["jit_donators"] = donators
+        self.summary["consts"] = consts
+        self.summary["env_ops"] = self._module_env_ops()
+
+    def _module_env_ops(self):
+        """Env-lane reads/writes in module-level code (``HEARTBEAT_INTERVAL
+        = float(os.environ.get(...))``) — the function extractor never sees
+        these, and a lane whose only consumer is an import-time default
+        would otherwise look like an orphan producer."""
+        ops = []
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Subscript):
+                    recv = dotted_name(sub.value) or ""
+                    key = _env_key(sub.slice)
+                    if key is None:
+                        continue
+                    if recv == "environ" or recv.endswith(".environ") or (
+                        not key.startswith("$") and _is_env_lane_literal(key)
+                    ):
+                        kind = "write" if isinstance(sub.ctx, (ast.Store, ast.Del)) else "read"
+                        ops.append([kind, key, sub.lineno])
+                elif isinstance(sub, ast.Call):
+                    name = dotted_name(sub.func) or ""
+                    tail = name.split(".")[-1]
+                    recv = (
+                        dotted_name(sub.func.value)
+                        if isinstance(sub.func, ast.Attribute)
+                        else None
+                    ) or ""
+                    is_environ = recv == "environ" or recv.endswith(".environ")
+                    key = _env_key(sub.args[0]) if sub.args else None
+                    if key is None:
+                        continue
+                    if name == "os.getenv" or (tail == "getenv" and not recv):
+                        ops.append(["read", key, sub.lineno])
+                    elif tail == "get" and (
+                        is_environ or key.startswith("$") or _is_env_lane_literal(key)
+                    ):
+                        ops.append(["read", key, sub.lineno])
+                    elif tail == "setdefault" and (
+                        is_environ or (not key.startswith("$") and _is_env_lane_literal(key))
+                    ):
+                        ops.append(["write", key, sub.lineno])
+        return ops
 
     def _class(self, node):
         cls = {
@@ -623,7 +998,11 @@ class _ModuleExtractor:
                                 cls["sync_attrs"].append(attr)
                         elif tail in QUEUE_CTORS:
                             bounded = tail != "SimpleQueue" and self._queue_bounded(sub.value)
-                            cls["queue_attrs"][attr] = {"bounded": bounded}
+                            cls["queue_attrs"][attr] = {
+                                "bounded": bounded,
+                                "line": sub.lineno,
+                                "mod": self._ctor_module(ctor),
+                            }
                         elif ctor:
                             cls["attr_types"][attr] = ctor
                 elif isinstance(sub, ast.Call):
@@ -635,6 +1014,16 @@ class _ModuleExtractor:
                             cls["spawn_targets"].append(tgt)
         for m in methods:
             self._function(m, node.name)
+
+    def _ctor_module(self, ctor):
+        """Defining module of a ctor ref, resolved through imports
+        (``queue_mod.Queue`` → ``queue``; bare ``Queue`` from-import →
+        ``queue``); the raw head when unresolvable (``_mp.Queue``)."""
+        if "." in ctor:
+            head = ctor.split(".", 1)[0]
+            return self.imports.get(head, head)
+        target = self.imports.get(ctor, "")
+        return target.rsplit(".", 1)[0] if "." in target else ""
 
     def _queue_bounded(self, call):
         if call.args:
@@ -863,7 +1252,7 @@ class ProjectIndex:
 
 # -- cache -------------------------------------------------------------------
 
-CACHE_VERSION = 2
+CACHE_VERSION = 4
 
 
 def _tool_signature():
