@@ -216,6 +216,13 @@ if [[ "$CHAOS" == "1" ]]; then
   # input-bound.
   echo "chaos leg: text-plane tokenize_error/pack_stall run"
   python -m pytest tests/test_chaos_text.py -q -m chaos
+  # store leg (self-installed plans): store.read_error must be absorbed by
+  # the store retry budget with the stream byte-identical, store.remote_stall
+  # must land in shard-read time (io_bound classification), and a
+  # store.prefetch_tear'd staged shard must be rejected by verify-on-read
+  # and re-fetched cold — all against the in-process HTTP fixture.
+  echo "chaos leg: store read_error/remote_stall/prefetch_tear run"
+  python -m pytest tests/test_store.py -q -m chaos
   # Benign-in-outcome sites at low probability: the suite's assertions
   # must keep passing — most sites only perturb timing; data.decode_kill
   # SIGKILLs a decode worker, which the plane's respawn-and-release
@@ -230,6 +237,9 @@ if [[ "$CHAOS" == "1" ]]; then
     "data.cache_tear":      {"probability": 0.05, "max_count": null},
     "data.readahead_stall": {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "data.pack_stall":      {"probability": 0.05, "max_count": null, "delay_s": 0.01},
+    "store.read_error":     {"probability": 0.02, "max_count": null},
+    "store.remote_stall":   {"probability": 0.05, "max_count": null, "delay_s": 0.01},
+    "store.prefetch_tear":  {"probability": 0.05, "max_count": null},
     "serving.latency":      {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "reservation.slow_accept": {"probability": 0.05, "max_count": null, "delay_s": 0.01},
     "control.lease_delay":  {"probability": 0.05, "max_count": null, "delay_s": 0.005},

@@ -146,6 +146,18 @@ site                            effect at the injection point
                                 backprop and the straggler stays visible in
                                 the MULTICHIP per-rank step-time spread
 ``native_io.read_fail``         TFRecord read raises ``IOError``
+``store.read_error``            one remote store HTTP request raises
+                                ``IOError`` — absorbed by the store's retry
+                                budget (``resilience_retries_total`` climbs,
+                                the stream stays byte-identical)
+``store.remote_stall``          remote store request sleeps ``delay_s`` — the
+                                latency lands in shard-read time, so the
+                                stall classifier calls the run io_bound and
+                                the prefetch autotuner must deepen
+``store.prefetch_tear``         staged-shard publish commits a torn
+                                ``MANIFEST.json``; verify-on-read must
+                                reject and recount the stage and the shard
+                                re-fetches cold
 ==============================  ==============================================
 """
 

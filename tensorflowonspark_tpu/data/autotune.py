@@ -403,6 +403,7 @@ class ReadaheadAutotuner:
         check_every=2.0,
         clock=None,
         read_counters=None,
+        gauge=None,
     ):
         self.min_depth = max(1, int(min_depth))
         self.max_depth = int(max_depth)
@@ -424,7 +425,10 @@ class ReadaheadAutotuner:
         self._ticker = DeltaTicker(
             self.check_every, read_counters or self._read_obs, clock=clock
         )
-        self._depth_g = obs.gauge(
+        # the depth gauge is injectable so other read-ahead-shaped planes
+        # (the store prefetch stager) can reuse the whole controller while
+        # publishing on their own metric name
+        self._depth_g = gauge if gauge is not None else obs.gauge(
             "readahead_depth", help="shard read-ahead depth currently allowed"
         )
 

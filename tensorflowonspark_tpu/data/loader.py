@@ -47,6 +47,7 @@ import numpy as np
 
 from tensorflowonspark_tpu import chaos, obs, resilience
 from tensorflowonspark_tpu.data import autotune, decode_plane, slab_cache
+from tensorflowonspark_tpu.store import base as store_base
 
 logger = logging.getLogger(__name__)
 
@@ -107,8 +108,13 @@ class _Stopped(Exception):
 def shard_files(files, num_shards, index):
     """Deterministic per-worker file sharding (the reference used
     ``ds.shard(num_workers, worker_num)``, mnist_inference.py:42 — same
-    round-robin contract)."""
-    files = sorted(files)
+    round-robin contract).
+
+    Sorted by shard basename first, full path second
+    (:func:`tensorflowonspark_tpu.store.base.shard_sort_key`): a local glob
+    and a remote URL listing of the same corpus order identically, so every
+    worker gets the same shards no matter where the corpus lives."""
+    files = sorted(files, key=store_base.shard_sort_key)
     if num_shards <= 1:
         return list(files)
     if index >= num_shards:
@@ -126,15 +132,58 @@ def _chunks_of(records, chunk_records):
         yield records[i : i + chunk_records]
 
 
-def _shard_chunk_iter(path, verify_crc, chunk_records):
+def _staged_or_cold(staged, path, store, verify_crc, chunk_records):
+    """Chunks of ``path`` from its staged local copy, falling back to the
+    cold remote read if the local copy fails before its first chunk — the
+    window where the capacity bound may have evicted the staged directory
+    between ``stager.fetch`` and the open. After the first chunk the file
+    handle pins the bytes (POSIX unlink semantics), so a mid-stream error
+    is a real one and surfaces."""
+    try:
+        it = _shard_chunk_iter(staged, verify_crc, chunk_records)
+        first = next(it, None)
+    except (OSError, IOError):
+        logger.warning(
+            "staged copy of %s unreadable (evicted or torn); reading cold", path
+        )
+        yield from _shard_chunk_iter(path, verify_crc, chunk_records, store=store)
+        return
+    if first is None:
+        return
+    yield first
+    yield from it
+
+
+def _shard_chunk_iter(path, verify_crc, chunk_records, store=None, stager=None):
     """Iterator of record-lists for one shard. ``chunk_records > 0``
     streams chunks (native ``tfr_stream_next`` for local files, the Python
     codec for fsspec URIs or a stale prebuilt library); ``chunk_records
-    <= 0`` is the bulk path — the whole shard as a single chunk."""
+    <= 0`` is the bulk path — the whole shard as a single chunk.
+
+    Remote shards (``store`` handles the path) are served from the staged
+    local copy when the prefetch ``stager`` has one (the read then falls
+    through to the native local fast path below), or stream *cold* through
+    the store's ranged chunk reads — same chunks, same bytes, either way.
+    A staged copy that fails before its first chunk (evicted by the
+    capacity bound between ``fetch`` and open, or corrupt on disk) falls
+    back to the cold remote read — serve cold, never garbage."""
     from tensorflowonspark_tpu import native_io, tfrecord
 
     if path.startswith("file://"):
         path = path[len("file://"):]
+    if store is not None and store.handles(path):
+        staged = stager.fetch(path) if stager is not None else None
+        if staged is not None:
+            store_base.note_backend("{} staged".format(store.fingerprint()))
+            return _staged_or_cold(
+                staged, path, store, verify_crc, chunk_records
+            )
+        elif chunk_records > 0:
+            return store.read_records_chunked(
+                path, chunk_records=chunk_records, verify_crc=verify_crc
+            )
+        else:
+            return iter([store.read_records(path, verify_crc=verify_crc)])
     local = not tfrecord.is_uri(path)
     if chunk_records > 0:
         if local and native_io.stream_available():
@@ -248,6 +297,20 @@ class ImagePipeline:
       decoding (see :mod:`~tensorflowonspark_tpu.data.slab_cache`). Only
       active when the ``parse_fn`` exposes ``cache_key``; the stream stays
       byte-identical with the cache on, off, cold or warm.
+    - ``store`` — an explicit
+      :class:`~tensorflowonspark_tpu.store.base.ShardStore` the shard paths
+      live in. ``http(s)://`` shard lists auto-detect an
+      :class:`~tensorflowonspark_tpu.store.http.HTTPStore`; ``gs://`` /
+      ``s3://`` corpora pass one explicitly with the matching endpoint
+      adapter. The record stream is byte-identical to reading the same
+      corpus from local disk.
+    - ``prefetch`` — remote-shard staging window (default env
+      ``TOS_STORE_PREFETCH`` or ``"auto"``): shards are downloaded to
+      executor-local disk (``TOS_PREFETCH_DIR``) ahead of the reader and
+      served through the native local fast path; ``"auto"`` lets the
+      read-ahead autotuner steer the window from the stall counters
+      (``store_prefetch_depth``); ``0`` streams cold through ranged remote
+      reads. Only meaningful with a remote ``store``.
 
     ``max_bad_records`` is the poisoned-input budget: records whose
     ``parse_fn`` raises are skipped (counted in
@@ -279,10 +342,27 @@ class ImagePipeline:
         recycle_buffers=False,
         decode_workers=None,
         slab_cache_dir=None,
+        store=None,
+        prefetch=None,
     ):
         if not files:
             raise ValueError("no input files")
         self.files = list(files)
+        # remote shard source: explicit store=, or auto-detected for
+        # http(s):// shard lists (gs://, s3:// need an explicit store with
+        # the matching endpoint adapter — never silently unauthenticated;
+        # other URI schemes keep today's fsspec route)
+        if store is None and any(
+            str(f).startswith(("http://", "https://")) for f in self.files
+        ):
+            from tensorflowonspark_tpu.store.http import resolve_store
+
+            store = resolve_store(self.files)
+        self.store = store
+        #: remote prefetch window (``TOS_STORE_PREFETCH`` default: "auto" =
+        #: stall-steered staging to local disk; "0" streams cold)
+        self.prefetch = prefetch
+        self._stager = None  # built per-iteration, after the plane forks
         self.parse_fn = parse_fn
         self.batch_size = int(batch_size)
         self.shuffle = shuffle
@@ -345,7 +425,10 @@ class ImagePipeline:
                         "chaos: injected shard read failure for {}".format(path)
                     )
                 time.sleep(spec.get("delay_s", 0.05))
-        return _shard_chunk_iter(path, self.verify_crc, chunk_records)
+        return _shard_chunk_iter(
+            path, self.verify_crc, chunk_records,
+            store=self.store, stager=self._stager,
+        )
 
     def _decorate(self, path, base, records):
         """Swap records for decoded-cache hits / cache-keyed raw records.
@@ -469,6 +552,10 @@ class ImagePipeline:
             order = list(self.files)
             if self.shuffle:
                 order_rng.shuffle(order)
+            if self._stager is not None:
+                # the staging tier warms its window in this epoch's visit
+                # order — the same order the reader executor will drain
+                self._stager.plan(order)
             records = (
                 rec
                 for chunk in self._epoch_chunks(reader_pool, order, stop, abort, read_c)
@@ -559,6 +646,16 @@ class ImagePipeline:
                     "unavailable here; falling back to the thread parse pool",
                     workers,
                 )
+
+        # the remote staging tier, rebuilt per iteration: its download pool
+        # spawns threads only on first submit (inside the producer thread),
+        # so constructing it here — after the plane forked — is fork-safe
+        stager = None
+        if self.store is not None:
+            from tensorflowonspark_tpu.store import staging as store_staging
+
+            stager = store_staging.resolve_stager(self.store, prefetch=self.prefetch)
+        self._stager = stager
 
         reader_pool = (
             ThreadPoolExecutor(self.readahead, thread_name_prefix="tos-data-reader")
@@ -950,6 +1047,9 @@ class ImagePipeline:
                 abort.set()
                 if reader_pool is not None:
                     reader_pool.shutdown(wait=False, cancel_futures=True)
+                if stager is not None:
+                    self._stager = None
+                    stager.close()
 
         thread = threading.Thread(target=producer, name="tos-data-producer", daemon=True)
         thread.start()

@@ -31,16 +31,32 @@ Observability (rows in docs/architecture.md's Metrics inventory):
 ==================================  =======================================
 metric                              meaning
 ==================================  =======================================
-``decode_cache_hits_total``         slot fills served from the cache
+``decode_cache_hits_total``         slot fills served from the cache (any tier)
 ``decode_cache_rejects_total``      generations rejected by cheap-verify
 ``decode_cache_bytes``              bytes resident in committed generations
+``tier_ram_hits_total``             hits served from the RAM tier
+``tier_disk_hits_total``            hits served from a disk generation
+``tier_promotions_total``           rows copied disk → RAM on a disk hit
+``tier_demotions_total``            rows dropped from RAM by its LRU bound
+``tier_evictions_total``            generations evicted by the disk bound
+``tier_ram_bytes``                  bytes resident in the RAM tier
 ==================================  =======================================
+
+Tier hierarchy (docs/architecture.md "Storage tiering"): RAM rows →
+local-disk decoded generations → whatever cold store the loader reads
+shards from (local filesystem, or a remote ``ShardStore`` behind the
+prefetch stager). Both cache tiers are capacity-bounded — the RAM tier
+drops least-recently-used rows (they stay on disk), the disk tier evicts
+whole least-recently-used *generations* (their records decode again from
+the cold store) — so the cache degrades to slower tiers, never to
+unbounded growth.
 
 Single-threaded by design: only the loader's producer thread touches a
 ``SlabCache`` (lookup/put/commit all happen on the slot-assignment path),
 mirroring how the decode plane's lease protocol is driven from one thread.
 """
 
+import collections
 import json
 import logging
 import os
@@ -56,6 +72,14 @@ logger = logging.getLogger(__name__)
 
 #: env default for the loader's ``slab_cache_dir`` knob
 ENV_VAR = "TOS_SLAB_CACHE_DIR"
+#: capacity bound (bytes) for the committed disk generations; 0/unset =
+#: unbounded (the pre-tiering behavior)
+BYTES_ENV_VAR = "TOS_SLAB_CACHE_BYTES"
+#: capacity bound (bytes) for the RAM promotion tier
+RAM_ENV_VAR = "TOS_SLAB_RAM_BYTES"
+#: default RAM tier size: big enough to hold a benchmark epoch's hot rows,
+#: small next to a training host's memory
+DEFAULT_RAM_BYTES = 64 * 1024 * 1024
 
 _DATA_NAME = "data.bin"
 _INDEX_NAME = "index.json"
@@ -90,7 +114,7 @@ class SlabCache:
     staging-dir contract.
     """
 
-    def __init__(self, root, cache_key, shape, dtype):
+    def __init__(self, root, cache_key, shape, dtype, max_bytes=None, ram_bytes=None):
         self.shape = tuple(int(s) for s in shape)
         self.dtype = np.dtype(dtype)
         if self.dtype.hasobject:
@@ -99,10 +123,22 @@ class SlabCache:
             os.path.abspath(os.path.expanduser(root)), _fingerprint(cache_key)
         )
         os.makedirs(self.dir, exist_ok=True)
+        if max_bytes is None:
+            max_bytes = int(os.environ.get(BYTES_ENV_VAR, "0")) or None
+        if ram_bytes is None:
+            ram_bytes = int(os.environ.get(RAM_ENV_VAR, str(DEFAULT_RAM_BYTES)))
+        self.max_bytes = max_bytes
+        self.ram_bytes = max(0, int(ram_bytes))
         self._row_bytes = int(np.prod(self.shape)) * self.dtype.itemsize
-        self._maps = []  # committed: (memmap, {key: (row, label)})
+        # committed generations: (memmap, {key: (row, label)}), tombstoned
+        # to None on eviction so _index map indices stay stable
+        self._maps = []
         self._index = {}  # key -> (map idx, row) merged across generations
         self._staging = None  # (dir, open data file, {key: (row, label)})
+        self._gen_dirs = {}  # map idx -> published directory (for eviction)
+        self._gen_use = {}  # map idx -> tick of last hit (LRU eviction order)
+        self._tick = 0
+        self._ram = collections.OrderedDict()  # key -> (row copy, label), LRU
         self._hits_c = obs.counter(
             "decode_cache_hits_total", help="slot fills served from the decoded-slab cache"
         )
@@ -112,6 +148,25 @@ class SlabCache:
         )
         self._bytes_g = obs.gauge(
             "decode_cache_bytes", help="bytes resident in committed decoded-slab generations"
+        )
+        self._ram_hits_c = obs.counter(
+            "tier_ram_hits_total", help="slab-cache hits served from the RAM tier"
+        )
+        self._disk_hits_c = obs.counter(
+            "tier_disk_hits_total", help="slab-cache hits served from a disk generation"
+        )
+        self._promote_c = obs.counter(
+            "tier_promotions_total", help="slab-cache rows promoted disk → RAM"
+        )
+        self._demote_c = obs.counter(
+            "tier_demotions_total", help="slab-cache rows demoted out of the RAM tier"
+        )
+        self._evict_c = obs.counter(
+            "tier_evictions_total",
+            help="slab-cache generations evicted by the disk capacity bound",
+        )
+        self._ram_bytes_g = obs.gauge(
+            "tier_ram_bytes", help="bytes resident in the slab-cache RAM tier"
         )
         self._load_generations()
 
@@ -150,7 +205,10 @@ class SlabCache:
                 table[int(key)] = (row, int(label))
                 self._index[int(key)] = (idx, row)
             self._maps.append((mm, table))
-        self._bytes_g.set(float(sum(mm.nbytes for mm, _ in self._maps)))
+            self._gen_dirs[idx] = gen
+            self._gen_use[idx] = 0
+        self._evict_over_capacity()
+        self._bytes_g.set(float(self._disk_bytes()))
         if self._index:
             logger.info("slab cache: %d row(s) across %d generation(s) at %s",
                         len(self._index), len(self._maps), self.dir)
@@ -173,16 +231,42 @@ class SlabCache:
         return os.path.join(self.dir, "gen-{:06d}".format(n))
 
     def lookup(self, key):
-        """``(pixels, label)`` for a record crc, or None. The pixels are a
-        read-only view of the generation's memory map — copy-on-assign into
-        the slab slot is the single copy on the hit path."""
-        loc = self._index.get(int(key))
+        """``(pixels, label)`` for a record crc, or None — RAM tier first,
+        then the disk generations (a disk hit promotes the row into RAM).
+        The pixels are a read-only view (memmap) or the promoted copy —
+        copy-on-assign into the slab slot is the single copy on either hit
+        path."""
+        key = int(key)
+        hit = self._ram.get(key)
+        if hit is not None:
+            self._ram.move_to_end(key)
+            self._hits_c.inc()
+            self._ram_hits_c.inc()
+            return hit
+        loc = self._index.get(key)
         if loc is None:
             return None
         mm, table = self._maps[loc[0]]
-        row, label = table[int(key)]
+        row, label = table[key]
+        self._tick += 1
+        self._gen_use[loc[0]] = self._tick
         self._hits_c.inc()
+        self._disk_hits_c.inc()
+        self._promote(key, mm[row], label)
         return mm[row], label
+
+    def _promote(self, key, pixels, label):
+        """Copy one disk-hit row into the RAM tier, demoting LRU rows past
+        the RAM bound (they stay on disk — demotion is a free drop)."""
+        if self._row_bytes > self.ram_bytes:
+            return
+        self._ram[key] = (np.array(pixels), int(label))
+        self._ram.move_to_end(key)
+        self._promote_c.inc()
+        while len(self._ram) * self._row_bytes > self.ram_bytes:
+            self._ram.popitem(last=False)
+            self._demote_c.inc()
+        self._ram_bytes_g.set(float(len(self._ram) * self._row_bytes))
 
     def __len__(self):
         return len(self._index)
@@ -271,9 +355,48 @@ class SlabCache:
             table[key] = (row, staged[key][1])
             self._index[key] = (idx, row)
         self._maps.append((mm, table))
-        self._bytes_g.set(float(sum(m.nbytes for m, _ in self._maps)))
+        self._gen_dirs[idx] = final
+        self._tick += 1
+        self._gen_use[idx] = self._tick
+        self._evict_over_capacity(keep=idx)
+        self._bytes_g.set(float(self._disk_bytes()))
         logger.info("slab cache: committed %d row(s) (%d total) at %s", rows, len(self._index), self.dir)
         return rows
+
+    # -- capacity bound ---------------------------------------------------------
+
+    def _disk_bytes(self):
+        return sum(entry[0].nbytes for entry in self._maps if entry is not None)
+
+    def _evict_over_capacity(self, keep=None):
+        """Evict least-recently-used generations until the committed bytes
+        fit ``max_bytes`` (never the just-committed ``keep``). An evicted
+        generation is tombstoned — map indices in ``_index`` stay stable —
+        and its records simply decode again from the cold store."""
+        if not self.max_bytes:
+            return
+        while self._disk_bytes() > self.max_bytes:
+            live = [
+                i for i, entry in enumerate(self._maps)
+                if entry is not None and i != keep
+            ]
+            if not live:
+                return
+            victim = min(live, key=lambda i: self._gen_use.get(i, 0))
+            mm, table = self._maps[victim]
+            self._maps[victim] = None
+            for key in table:
+                self._index.pop(key, None)
+                self._ram.pop(key, None)
+            self._ram_bytes_g.set(float(len(self._ram) * self._row_bytes))
+            gen = self._gen_dirs.pop(victim, None)
+            self._gen_use.pop(victim, None)
+            self._evict_c.inc()
+            logger.info("slab cache: evicting generation %s (disk tier over capacity)", gen)
+            del mm
+            if gen:
+                shutil.rmtree(gen, ignore_errors=True)
+            self._bytes_g.set(float(self._disk_bytes()))
 
     def close(self):
         """Release memory maps and discard any uncommitted staging dir."""
@@ -287,3 +410,6 @@ class SlabCache:
             shutil.rmtree(stage, ignore_errors=True)
         self._maps = []
         self._index = {}
+        self._ram.clear()
+        self._gen_dirs = {}
+        self._gen_use = {}

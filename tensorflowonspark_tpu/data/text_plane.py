@@ -142,6 +142,8 @@ class TextPipeline(ImagePipeline):
         pack_workers=None,
         pack_ahead=2.0,
         slab_cache_dir=None,
+        store=None,
+        prefetch=None,
     ):
         if cache == "decoded":
             raise ValueError(
@@ -169,6 +171,8 @@ class TextPipeline(ImagePipeline):
             cache=cache,
             decode_workers=pack_workers,
             slab_cache_dir=slab_cache_dir,
+            store=store,
+            prefetch=prefetch,
         )
         self.tokenizer = tokenizer
         self.seq_len = seq_len

@@ -18,6 +18,7 @@ import subprocess
 import threading
 
 from tensorflowonspark_tpu import chaos, resilience
+from tensorflowonspark_tpu.store import framing
 
 logger = logging.getLogger(__name__)
 
@@ -226,6 +227,45 @@ def _stream_open(lib, path, verify_crc):
     return handle
 
 
+class _StreamChunkReader(framing.ChunkReader):
+    """The native stream behind the shared ``open → read_chunk → close``
+    chunk contract (:mod:`tensorflowonspark_tpu.store.framing`): opening
+    fires the ``native_io.read_fail`` chaos seam exactly as before, and
+    ``read_chunk`` slices one ``tfr_stream_next`` buffer per call."""
+
+    def __init__(self, lib, path, verify_crc):
+        self._lib = lib
+        self._handle = _stream_open(lib, path, verify_crc)
+
+    def read_chunk(self, max_records):
+        chunk = self._lib.tfr_stream_next(self._handle, int(max_records))
+        if not chunk:
+            err = self._lib.tfr_last_error().decode()
+            if err:
+                raise IOError(err)
+            return []  # clean EOF
+        try:
+            return _slice_records(self._lib, chunk)
+        finally:
+            self._lib.tfr_free(chunk)
+
+    def close(self):
+        handle, self._handle = self._handle, None
+        if handle:
+            self._lib.tfr_stream_close(handle)
+
+
+def open_chunk_reader(path, verify_crc=True):
+    """A :class:`_StreamChunkReader` over one shard (the native fast path
+    ``store.LocalStore.open`` hands to the loader). Raises ``RuntimeError``
+    when the library lacks the streaming API — check
+    :func:`stream_available` first."""
+    lib = load_library()
+    if lib is None or not lib.tfr_has_stream:
+        raise RuntimeError("native tfrecord_io streaming not available")
+    return _StreamChunkReader(lib, path, verify_crc)
+
+
 def read_records_chunked(path, chunk_records=1024, verify_crc=True):
     """Yield lists of up to ``chunk_records`` record payloads, reading the
     shard incrementally (``tfr_stream_next``) instead of materializing it.
@@ -235,25 +275,17 @@ def read_records_chunked(path, chunk_records=1024, verify_crc=True):
     worth of IO instead of a whole shard's. The open is retried under
     ``READ_RETRY`` (transient filesystem errors); mid-stream corruption is
     NOT retried — the stream position is gone, and corrupt bytes don't heal.
+    Both behaviors come from the shared chunk loop
+    (:func:`tensorflowonspark_tpu.store.framing.iter_chunks`).
     """
     lib = load_library()
     if lib is None or not lib.tfr_has_stream:
         raise RuntimeError("native tfrecord_io streaming not available")
-    handle = READ_RETRY.call(_stream_open, lib, path, verify_crc)
-    try:
-        while True:
-            chunk = lib.tfr_stream_next(handle, int(chunk_records))
-            if not chunk:
-                err = lib.tfr_last_error().decode()
-                if err:
-                    raise IOError(err)
-                return  # clean EOF
-            try:
-                yield _slice_records(lib, chunk)
-            finally:
-                lib.tfr_free(chunk)
-    finally:
-        lib.tfr_stream_close(handle)
+    return framing.iter_chunks(
+        lambda: _StreamChunkReader(lib, path, verify_crc),
+        chunk_records,
+        retry=READ_RETRY,
+    )
 
 
 def write_records(path, records):

@@ -25,7 +25,7 @@ tensorflow/core/example/example.proto, feature.proto (schema).
 import os
 import struct
 
-import google_crc32c
+from tensorflowonspark_tpu.store import framing
 
 # -- filesystem routing (local fast path; fsspec for URI schemes) -------------
 
@@ -83,13 +83,12 @@ def rename(src, dst):
         os.replace(src, dst)
 
 # -- TFRecord framing ----------------------------------------------------------
+# The read-side framing loop lives in store/framing.py (one copy shared with
+# native_io and the remote stores); this module keeps the write path and the
+# open_file routing that covers fsspec URIs.
 
-_MASK_DELTA = 0xA282EAD8
-
-
-def _masked_crc(data):
-    crc = int.from_bytes(google_crc32c.Checksum(data).digest(), "big")
-    return ((((crc >> 15) | (crc << 17)) + _MASK_DELTA) & 0xFFFFFFFF)
+_MASK_DELTA = framing._MASK_DELTA
+_masked_crc = framing.masked_crc
 
 
 class TFRecordWriter:
@@ -116,23 +115,7 @@ class TFRecordWriter:
 def read_records(path, verify_crc=True):
     """Yield raw record bytes from a TFRecord file (local or fsspec URI)."""
     with open_file(path, "rb") as f:
-        while True:
-            header = f.read(8)
-            if not header:
-                return
-            if len(header) != 8:
-                raise IOError("truncated TFRecord length header in {}".format(path))
-            (length,) = struct.unpack("<Q", header)
-            (len_crc,) = struct.unpack("<I", f.read(4))
-            if verify_crc and _masked_crc(header) != len_crc:
-                raise IOError("corrupt TFRecord length crc in {}".format(path))
-            data = f.read(length)
-            if len(data) != length:
-                raise IOError("truncated TFRecord payload in {}".format(path))
-            (data_crc,) = struct.unpack("<I", f.read(4))
-            if verify_crc and _masked_crc(data) != data_crc:
-                raise IOError("corrupt TFRecord payload crc in {}".format(path))
-            yield data
+        yield from framing.read_framed(f, path, verify_crc=verify_crc)
 
 
 def read_records_chunked(path, chunk_records=1024, verify_crc=True):
@@ -141,14 +124,12 @@ def read_records_chunked(path, chunk_records=1024, verify_crc=True):
     :func:`tensorflowonspark_tpu.native_io.read_records_chunked` so the
     loader's chunked path works identically with either codec (this one also
     covers fsspec URIs, which the native reader cannot open)."""
-    chunk = []
-    for rec in read_records(path, verify_crc=verify_crc):
-        chunk.append(rec)
-        if len(chunk) >= chunk_records:
-            yield chunk
-            chunk = []
-    if chunk:
-        yield chunk
+    return framing.iter_chunks(
+        lambda: framing.FramedChunkReader(
+            open_file(path, "rb"), path, verify_crc=verify_crc
+        ),
+        chunk_records,
+    )
 
 
 # -- minimal protobuf wire codec ----------------------------------------------

@@ -140,11 +140,18 @@ def test_feed_fields_reports_link_estimate_and_stalls():
     assert set(out["stalls"]) == {
         "producer_read_seconds", "producer_parse_seconds",
         "producer_emit_seconds", "consumer_wait_seconds",
-        "classification",
+        "classification", "store",
     }
     assert out["stalls"]["classification"] in {
         "device_bound", "decode_bound", "io_bound",
     }
+    # store provenance rides in the stalls block: backend fingerprint plus
+    # the per-tier hit/miss/promotion counters
+    store = out["stalls"]["store"]
+    assert isinstance(store["backend"], str) and store["backend"]
+    for k in ("remote_reads", "prefetch_hits", "tier_ram_hits",
+              "tier_disk_hits", "tier_promotions"):
+        assert isinstance(store[k], int)
 
     tuner.note_fixed_probe(0.25)
     tuner.note_transfer(1 << 20, 0.25 + (1 << 20) / 20e6)

@@ -322,3 +322,37 @@ class TestGilRelease:
         thread = _rate(0)
         procs = _rate(4)
         assert procs > 1.5 * thread, (thread, procs)
+
+    def test_process_pool_hits_3x_on_4plus_cores(self, tmp_path):
+        """The multi-core demonstration the plane has waited on: with >= 4
+        real cores the 4-process pool must clear 3x the 1-thread pool on a
+        GIL-bound parse (the ``BENCH_MODE=decode`` gil leg records the same
+        ratio). Skipped below 4 cores, where the recorded status quo is the
+        single-core ~1x of docs/perf.md."""
+        if (os.cpu_count() or 1) < 4:
+            pytest.skip("needs >= 4 cores to demonstrate 3x GIL-free decode")
+        from tensorflowonspark_tpu import tfrecord
+        from tensorflowonspark_tpu.data import ImagePipeline
+
+        p = str(tmp_path / "part-00000")
+        with tfrecord.TFRecordWriter(p) as w:
+            for i in range(160):
+                w.write(str(i).encode())
+
+        def _rate(decode_workers, batches=12):
+            pipe = ImagePipeline(
+                [p], _gil_bound_parse, batch_size=8, seed=0, epochs=None,
+                num_threads=1, decode_workers=decode_workers,
+            )
+            it = iter(pipe)
+            next(it)  # bootstrap + pool spin-up outside the clock
+            t0 = time.monotonic()
+            for _ in range(batches):
+                next(it)
+            dt = time.monotonic() - t0
+            del it
+            return batches * 8 / dt
+
+        thread = _rate(0)
+        procs = max(_rate(4), _rate(4))  # best-of-2: absorb scheduler noise
+        assert procs >= 3.0 * thread, (thread, procs)

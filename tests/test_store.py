@@ -1,0 +1,851 @@
+"""store/ subsystem: the shared framing/chunk implementation, the
+ShardStore ABI (LocalStore, HTTPStore with range-GETs and the GCS/S3
+endpoint adapters against in-process fixtures), the prefetch staging
+tier's durable commit / verify-on-read / LRU eviction, deterministic
+local-vs-remote shard assignment, the byte-identical stream matrix
+(local / HTTP-cold / warm-staged / post-eviction, image and text planes),
+and the ``store.*`` chaos sites."""
+
+import functools
+import http.server
+import json
+import os
+import threading
+import urllib.parse
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import chaos, obs, tfrecord
+from tensorflowonspark_tpu.data import ImagePipeline, TextPipeline, Tokenizer
+from tensorflowonspark_tpu.data.loader import shard_files
+from tensorflowonspark_tpu.store import (
+    GCSAdapter,
+    HTTPStore,
+    LocalStore,
+    S3Adapter,
+    base,
+    framing,
+    resolve_store,
+    shard_sort_key,
+)
+from tensorflowonspark_tpu.store import staging
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def _counter(name):
+    return obs.snapshot()["counters"].get(name, {}).get("value", 0)
+
+
+# -- corpus + stream helpers (the loader-test idiom) ------------------------
+
+
+def _write_shards(root, n_shards=3, per=47, name="corpus"):
+    d = os.path.join(str(root), name)
+    os.makedirs(d, exist_ok=True)
+    idx = 0
+    paths = []
+    for s in range(n_shards):
+        p = os.path.join(d, "part-{:05d}".format(s))
+        with tfrecord.TFRecordWriter(p) as w:
+            for _ in range(per):
+                w.write(str(idx).encode())
+                idx += 1
+        paths.append(p)
+    return d, paths
+
+
+def _parse(rec):
+    v = int(rec)
+    return np.full((4, 4, 1), v % 251, np.uint8), v
+
+
+def _stream(pipe):
+    out = []
+    for b in pipe:
+        out.append((np.array(b["image"]).tobytes(), np.array(b["label"]).tobytes()))
+    return out
+
+
+def _records(chunks_iter):
+    return [rec for chunk in chunks_iter for rec in chunk]
+
+
+# -- in-process HTTP fixtures (no cloud creds, no sockets past loopback) ----
+
+
+class _RangeHandler(http.server.SimpleHTTPRequestHandler):
+    """Directory server that honors single byte ranges with 206 — the
+    object-store access pattern plain ``http.server`` ignores."""
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        path = self.translate_path(self.path)
+        if os.path.isdir(path):
+            return super().do_GET()  # directory-index listing
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            self.send_error(404)
+            return
+        rng = self.headers.get("Range", "")
+        if rng.startswith("bytes="):
+            start_s, _, end_s = rng[len("bytes="):].partition("-")
+            start = int(start_s)
+            if start >= len(data):
+                self.send_response(416)
+                self.send_header("Content-Range", "bytes */{}".format(len(data)))
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            end = min(int(end_s) if end_s else len(data) - 1, len(data) - 1)
+            body = data[start : end + 1]
+            self.send_response(206)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header(
+                "Content-Range", "bytes {}-{}/{}".format(start, end, len(data))
+            )
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+class _PlainHandler(http.server.SimpleHTTPRequestHandler):
+    """Stock behavior: the Range header is ignored, every GET answers 200
+    with the whole body — the fallback HTTPStore must slice client-side."""
+
+    def log_message(self, *args):
+        pass
+
+
+class _ObjectHandler(http.server.BaseHTTPRequestHandler):
+    """Minimal GCS-JSON / S3-ListObjectsV2 object endpoint over one
+    ``{"bucket/key": bytes}`` corpus dict (set per-server)."""
+
+    corpus = {}
+
+    def log_message(self, *args):
+        pass
+
+    def _resolve(self):
+        parsed = urllib.parse.urlparse(self.path)
+        qs = urllib.parse.parse_qs(parsed.query)
+        path = urllib.parse.unquote(parsed.path).lstrip("/")
+        if parsed.path.startswith("/storage/v1/b/"):  # GCS JSON listing
+            bucket = parsed.path.split("/")[4]
+            prefix = urllib.parse.unquote(qs.get("prefix", [""])[0])
+            items = [
+                {"name": k.split("/", 1)[1]}
+                for k in sorted(self.corpus)
+                if k.startswith(bucket + "/" + prefix)
+            ]
+            return 200, json.dumps({"items": items}).encode()
+        if "list-type" in qs:  # S3 ListObjectsV2
+            bucket = path.split("?")[0]
+            prefix = urllib.parse.unquote(qs.get("prefix", [""])[0])
+            keys = [
+                k.split("/", 1)[1]
+                for k in sorted(self.corpus)
+                if k.startswith(bucket + "/" + prefix)
+            ]
+            xml = "".join("<Key>{}</Key>".format(k) for k in keys)
+            return 200, ("<ListBucketResult>" + xml + "</ListBucketResult>").encode()
+        data = self.corpus.get(path)
+        if data is None:
+            return 404, b""
+        return 200, data
+
+    def _reply(self, status, body, send_body):
+        if status != 200:
+            self.send_response(status)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        rng = self.headers.get("Range", "")
+        if send_body and rng.startswith("bytes="):
+            start_s, _, end_s = rng[len("bytes="):].partition("-")
+            start = int(start_s)
+            if start >= len(body):
+                self.send_response(416)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            end = min(int(end_s) if end_s else len(body) - 1, len(body) - 1)
+            body = body[start : end + 1]
+            status = 206
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if send_body:
+            self.wfile.write(body)
+
+    def do_GET(self):
+        status, body = self._resolve()
+        self._reply(status, body, send_body=True)
+
+    def do_HEAD(self):
+        status, body = self._resolve()
+        self._reply(status, body, send_body=False)
+
+
+def _serve(handler):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, "http://127.0.0.1:{}".format(srv.server_address[1])
+
+
+@pytest.fixture
+def http_corpus(tmp_path):
+    """(url root, local dir, local paths, url paths) over one corpus served
+    by the range-capable in-process server."""
+    d, paths = _write_shards(tmp_path)
+    handler = functools.partial(_RangeHandler, directory=str(tmp_path))
+    srv, root = _serve(handler)
+    url_root = root + "/corpus"
+    urls = [url_root + "/" + os.path.basename(p) for p in paths]
+    yield url_root, d, paths, urls
+    srv.shutdown()
+    srv.server_close()
+
+
+@pytest.fixture
+def _prefetch_env(tmp_path, monkeypatch):
+    """Isolate the staging tier per-test: fresh root, no capacity bound."""
+    root = tmp_path / "prefetch"
+    monkeypatch.setenv(staging.DIR_ENV, str(root))
+    monkeypatch.delenv(staging.BYTES_ENV, raising=False)
+    monkeypatch.delenv(staging.DEPTH_ENV, raising=False)
+    return str(root)
+
+
+# -- framing: the one chunk implementation ---------------------------------
+
+
+class TestFraming:
+    def test_read_framed_matches_tfrecord_reader(self, tmp_path):
+        _, paths = _write_shards(tmp_path, n_shards=1, per=13)
+        with open(paths[0], "rb") as f:
+            framed = list(framing.read_framed(f, paths[0]))
+        assert framed == list(tfrecord.read_records(paths[0]))
+        assert framed == [str(i).encode() for i in range(13)]
+
+    def test_truncation_and_crc_errors_surface(self, tmp_path):
+        _, paths = _write_shards(tmp_path, n_shards=1, per=5)
+        blob = open(paths[0], "rb").read()
+        torn = tmp_path / "torn"
+        torn.write_bytes(blob[:-3])
+        with pytest.raises(IOError):
+            with open(str(torn), "rb") as f:
+                list(framing.read_framed(f, "torn"))
+        flipped = tmp_path / "flipped"
+        flipped.write_bytes(blob[:20] + bytes([blob[20] ^ 0xFF]) + blob[21:])
+        with pytest.raises(IOError):
+            with open(str(flipped), "rb") as f:
+                list(framing.read_framed(f, "flipped"))
+
+    def test_chunk_loop_is_shared_by_both_readers(self, tmp_path):
+        """Satellite: tfrecord and native_io both delegate to
+        framing.iter_chunks — same chunk boundaries, same records."""
+        from tensorflowonspark_tpu import native_io
+
+        _, paths = _write_shards(tmp_path, n_shards=1, per=29)
+        py_chunks = [list(c) for c in tfrecord.read_records_chunked(paths[0], chunk_records=8)]
+        assert [len(c) for c in py_chunks] == [8, 8, 8, 5]
+        assert [r for c in py_chunks for r in c] == [str(i).encode() for i in range(29)]
+        if native_io.stream_available():
+            nat = [list(c) for c in native_io.read_records_chunked(paths[0], chunk_records=8)]
+            assert nat == py_chunks
+
+    def test_iter_chunks_retries_open_not_midstream(self, tmp_path):
+        from tensorflowonspark_tpu import resilience
+
+        _, paths = _write_shards(tmp_path, n_shards=1, per=6)
+        attempts = [0]
+
+        def flaky_open():
+            attempts[0] += 1
+            if attempts[0] == 1:
+                raise IOError("transient open")
+            return framing.FramedChunkReader(open(paths[0], "rb"), paths[0])
+
+        retry = resilience.RetryPolicy(
+            max_attempts=3,
+            backoff=resilience.Backoff(base=0.0, factor=1.0, max_delay=0.0, jitter=0.0),
+            retry_on=(OSError,),
+            name="test-open",
+        )
+        recs = _records(framing.iter_chunks(flaky_open, 4, retry=retry))
+        assert attempts[0] == 2
+        assert recs == [str(i).encode() for i in range(6)]
+
+
+# -- LocalStore -------------------------------------------------------------
+
+
+class TestLocalStore:
+    def test_list_stat_read_fetch(self, tmp_path):
+        d, paths = _write_shards(tmp_path)
+        store = LocalStore()
+        assert store.handles(paths[0]) and store.handles("file://" + paths[0])
+        assert not store.handles("http://x/y")
+        assert store.list_shards(d) == paths
+        assert store.stat(paths[0])["size"] == os.path.getsize(paths[0])
+        recs = _records(store.read_records_chunked(paths[0], chunk_records=16))
+        assert recs == list(tfrecord.read_records(paths[0]))
+        import io
+
+        buf = io.BytesIO()
+        n = store.fetch(paths[0], buf)
+        assert n == os.path.getsize(paths[0])
+        assert buf.getvalue() == open(paths[0], "rb").read()
+
+
+# -- HTTPStore over the in-process fixtures --------------------------------
+
+
+class TestHTTPStore:
+    def test_list_stat_and_chunked_read_match_local(self, http_corpus):
+        url_root, d, paths, urls = http_corpus
+        store = HTTPStore(range_bytes=512)
+        shards = store.list_shards(url_root)
+        assert [u.rsplit("/", 1)[-1] for u in shards] == [
+            os.path.basename(p) for p in paths
+        ]
+        assert store.stat(urls[0])["size"] == os.path.getsize(paths[0])
+        for url, path in zip(urls, paths):
+            assert _records(store.read_records_chunked(url, chunk_records=16)) == list(
+                tfrecord.read_records(path)
+            )
+
+    def test_fetch_downloads_identical_bytes(self, http_corpus):
+        import io
+
+        _, _, paths, urls = http_corpus
+        store = HTTPStore(range_bytes=100)  # many ranges per object
+        buf = io.BytesIO()
+        n = store.fetch(urls[1], buf)
+        want = open(paths[1], "rb").read()
+        assert n == len(want) and buf.getvalue() == want
+
+    def test_200_fallback_when_server_ignores_range(self, tmp_path):
+        """Plain http.server answers 200 + whole body; read_range slices
+        client-side so the stream is still byte-identical."""
+        d, paths = _write_shards(tmp_path)
+        handler = functools.partial(_PlainHandler, directory=str(tmp_path))
+        srv, root = _serve(handler)
+        try:
+            store = HTTPStore(range_bytes=64)
+            url = root + "/corpus/" + os.path.basename(paths[0])
+            blob = open(paths[0], "rb").read()
+            assert store.read_range(url, 10, 29) == blob[10:30]
+            assert _records(store.read_records_chunked(url, chunk_records=8)) == list(
+                tfrecord.read_records(paths[0])
+            )
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_remote_read_metrics_count(self, http_corpus):
+        _, _, paths, urls = http_corpus
+        before = _counter("store_remote_reads_total")
+        store = HTTPStore(range_bytes=256)
+        _records(store.read_records_chunked(urls[0], chunk_records=16))
+        assert _counter("store_remote_reads_total") > before
+
+    def test_resolve_store_schemes(self, tmp_path):
+        assert resolve_store(["/a/part-0", "/a/part-1"]) is None
+        s = resolve_store(["http://h/a", "https://h/b"])
+        assert isinstance(s, HTTPStore)
+        assert isinstance(resolve_store(["gs://b/k"]).adapter, GCSAdapter)
+        assert isinstance(resolve_store(["s3://b/k"]).adapter, S3Adapter)
+        with pytest.raises(ValueError):
+            resolve_store(["/a/part-0", "http://h/part-1"])
+
+
+class TestEndpointAdapters:
+    def _serve_corpus(self, paths, bucket="bkt"):
+        corpus = {
+            "{}/corpus/{}".format(bucket, os.path.basename(p)): open(p, "rb").read()
+            for p in paths
+        }
+        handler = type("_H", (_ObjectHandler,), {"corpus": corpus})
+        return _serve(handler)
+
+    def test_gcs_adapter_lists_and_reads(self, tmp_path):
+        _, paths = _write_shards(tmp_path)
+        srv, endpoint = self._serve_corpus(paths)
+        try:
+            store = HTTPStore(adapter=GCSAdapter(endpoint=endpoint), range_bytes=256)
+            shards = store.list_shards("gs://bkt/corpus")
+            assert shards == [
+                "gs://bkt/corpus/" + os.path.basename(p) for p in paths
+            ]
+            assert _records(
+                store.read_records_chunked(shards[0], chunk_records=16)
+            ) == list(tfrecord.read_records(paths[0]))
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_s3_adapter_lists_and_reads(self, tmp_path):
+        _, paths = _write_shards(tmp_path)
+        srv, endpoint = self._serve_corpus(paths)
+        try:
+            store = HTTPStore(adapter=S3Adapter(endpoint=endpoint), range_bytes=256)
+            shards = store.list_shards("s3://bkt/corpus")
+            assert shards == [
+                "s3://bkt/corpus/" + os.path.basename(p) for p in paths
+            ]
+            assert _records(
+                store.read_records_chunked(shards[2], chunk_records=16)
+            ) == list(tfrecord.read_records(paths[2]))
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+# -- deterministic shard assignment (local == remote) -----------------------
+
+
+class TestShardAssignment:
+    def test_shard_files_orders_urls_like_local_paths(self, http_corpus):
+        """Satellite: identical worker→shard assignment whether the corpus
+        is listed from a local glob or a remote store."""
+        url_root, d, paths, _ = http_corpus
+        local = LocalStore().list_shards(d)
+        remote = HTTPStore().list_shards(url_root)
+        assert [os.path.basename(p) for p in local] == [
+            u.rsplit("/", 1)[-1] for u in remote
+        ]
+        for num_shards in (1, 2, 3):
+            for index in range(num_shards):
+                l = shard_files(local, num_shards, index)
+                r = shard_files(remote, num_shards, index)
+                assert [os.path.basename(p) for p in l] == [
+                    u.rsplit("/", 1)[-1] for u in r
+                ], (num_shards, index)
+
+    def test_shard_files_sorts_unsorted_listings(self, tmp_path):
+        d, paths = _write_shards(tmp_path)
+        shuffled = [paths[2], paths[0], paths[1]]
+        assert shard_files(shuffled, 1, 0) == paths
+        urls = ["http://h/c/" + os.path.basename(p) for p in shuffled]
+        assert shard_files(urls, 2, 0) == sorted(urls, key=shard_sort_key)[0::2]
+
+    def test_sort_key_is_basename_first(self):
+        # two roots, interleaved basenames: basename ordering wins so a
+        # re-rooted corpus (local dir vs URL) assigns identically
+        mixed = ["/b/part-00001", "/a/part-00000"]
+        assert sorted(mixed, key=shard_sort_key) == ["/a/part-00000", "/b/part-00001"]
+
+
+# -- prefetch staging tier --------------------------------------------------
+
+
+class TestPrefetchStager:
+    def test_resolve_stager_knobs(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(staging.DIR_ENV, str(tmp_path / "p"))
+        store = LocalStore()
+        assert staging.resolve_stager(store, prefetch="0") is None
+        assert staging.resolve_stager(store, prefetch="off") is None
+        fixed = staging.resolve_stager(store, prefetch="3")
+        try:
+            assert fixed.depth == 3 and fixed._tuner is None
+        finally:
+            fixed.close()
+        auto = staging.resolve_stager(store, prefetch="auto")
+        try:
+            assert auto._tuner is not None
+        finally:
+            auto.close()
+
+    def test_stage_commit_hit_and_warm_reopen(self, http_corpus, tmp_path):
+        _, d, paths, urls = http_corpus
+        store = HTTPStore(range_bytes=512)
+        root = str(tmp_path / "stage")
+        stager = staging.PrefetchStager(store, root=root, depth=2)
+        try:
+            before_hits = _counter("store_prefetch_hits_total")
+            stager.plan(urls)
+            local0 = stager.fetch(urls[0])
+            assert local0 and open(local0, "rb").read() == open(paths[0], "rb").read()
+            # second fetch of the same shard: staged-tier hit, no download
+            assert stager.fetch(urls[0]) == local0
+            assert _counter("store_prefetch_hits_total") > before_hits
+        finally:
+            stager.close()
+        # a new stager (fresh process) adopts the staged dir and verifies
+        # it on first use — bytes still identical
+        warm = staging.PrefetchStager(store, root=root, depth=2)
+        try:
+            again = warm.fetch(urls[0])
+            assert again and open(again, "rb").read() == open(paths[0], "rb").read()
+        finally:
+            warm.close()
+
+    def test_verify_on_read_rejects_corrupt_staged_shard(self, http_corpus, tmp_path):
+        _, _, paths, urls = http_corpus
+        store = HTTPStore(range_bytes=512)
+        root = str(tmp_path / "stage")
+        stager = staging.PrefetchStager(store, root=root, depth=1)
+        try:
+            stager.plan(urls[:1])
+            local0 = stager.fetch(urls[0])
+            assert local0
+        finally:
+            stager.close()
+        # flip one byte of the staged data file behind the manifest's back
+        blob = bytearray(open(local0, "rb").read())
+        blob[5] ^= 0xFF
+        open(local0, "wb").write(bytes(blob))
+        before = _counter("store_prefetch_rejects_total")
+        fresh = staging.PrefetchStager(store, root=root, depth=1)
+        try:
+            fresh.plan(urls[:1])
+            refetched = fresh.fetch(urls[0])
+            assert _counter("store_prefetch_rejects_total") > before
+            # the tear was rejected and the shard re-staged from remote
+            assert refetched and open(refetched, "rb").read() == open(
+                paths[0], "rb"
+            ).read()
+        finally:
+            fresh.close()
+
+    def test_capacity_bound_evicts_lru(self, http_corpus, tmp_path):
+        _, _, paths, urls = http_corpus
+        store = HTTPStore(range_bytes=512)
+        before = _counter("store_prefetch_evictions_total")
+        stager = staging.PrefetchStager(
+            store, root=str(tmp_path / "stage"), depth=1, capacity_bytes=1
+        )
+        try:
+            stager.plan(urls)
+            for u in urls:
+                assert stager.fetch(u) is not None
+            assert _counter("store_prefetch_evictions_total") > before
+            resident = [
+                n for n in os.listdir(stager.root) if n.startswith("obj-")
+            ]
+            assert len(resident) == 1  # the bound keeps at least one shard
+        finally:
+            stager.close()
+
+
+# -- byte-identical stream matrix (the tentpole's contract) -----------------
+
+
+class TestStreamMatrix:
+    def _pipe(self, files, **kw):
+        kw.setdefault("batch_size", 8)
+        kw.setdefault("seed", 3)
+        kw.setdefault("epochs", 2)
+        kw.setdefault("num_threads", 2)
+        return ImagePipeline(files, _parse, **kw)
+
+    def test_image_stream_identical_local_http_warm_evicted(
+        self, http_corpus, _prefetch_env, monkeypatch
+    ):
+        url_root, d, paths, urls = http_corpus
+        local = _stream(self._pipe(paths))
+        assert local, "pipeline yielded nothing"
+        # cold: every chunk range-GETs straight off the remote store
+        cold = _stream(self._pipe(urls, prefetch="0"))
+        assert cold == local
+        # staged: first pass downloads + commits, second pass is warm
+        staged1 = _stream(self._pipe(urls, prefetch="2"))
+        assert staged1 == local
+        hits_before = _counter("store_prefetch_hits_total")
+        staged2 = _stream(self._pipe(urls, prefetch="2"))
+        assert staged2 == local
+        assert _counter("store_prefetch_hits_total") > hits_before
+        # post-eviction: a 1-byte capacity bound evicts behind every fetch,
+        # so most shards re-stage cold — bytes must not change
+        monkeypatch.setenv(staging.BYTES_ENV, "1")
+        evb = _counter("store_prefetch_evictions_total")
+        evicted = _stream(self._pipe(urls, prefetch="2"))
+        assert evicted == local
+        assert _counter("store_prefetch_evictions_total") > evb
+
+    def test_image_stream_autodetects_store_for_urls(self, http_corpus, _prefetch_env):
+        _, _, paths, urls = http_corpus
+        pipe = self._pipe(urls, prefetch="0")
+        assert isinstance(pipe.store, HTTPStore)
+        assert _stream(pipe) == _stream(self._pipe(paths))
+
+    def test_explicit_store_and_max_bad_records_contract(self, http_corpus, _prefetch_env):
+        _, _, paths, urls = http_corpus
+        store = HTTPStore(range_bytes=512)
+
+        def parse_or_raise(rec):
+            v = int(rec)
+            if v % 17 == 0:
+                raise ValueError("undecodable {}".format(v))
+            return np.full((4, 4, 1), v % 251, np.uint8), v
+
+        a = _stream(
+            ImagePipeline(
+                paths, parse_or_raise, batch_size=8, seed=3, epochs=1,
+                max_bad_records=100,
+            )
+        )
+        b = _stream(
+            ImagePipeline(
+                urls, parse_or_raise, batch_size=8, seed=3, epochs=1,
+                max_bad_records=100, store=store, prefetch="0",
+            )
+        )
+        assert a and a == b
+
+    def test_text_stream_identical_local_http_warm(self, http_corpus, _prefetch_env, tmp_path):
+        rng = np.random.default_rng(11)
+        words = "remote shard store streams packed text identically".split()
+        texts = [
+            " ".join(rng.choice(words, size=int(rng.integers(2, 12))))
+            for _ in range(90)
+        ]
+        d = tmp_path / "text"
+        d.mkdir()
+        paths = []
+        for s in range(2):
+            p = str(d / "part-{:05d}".format(s))
+            with tfrecord.TFRecordWriter(p) as w:
+                for t in texts[s * 45 : (s + 1) * 45]:
+                    w.write(t.encode())
+            paths.append(p)
+        handler = functools.partial(_RangeHandler, directory=str(tmp_path))
+        srv, root = _serve(handler)
+        try:
+            urls = [root + "/text/" + os.path.basename(p) for p in paths]
+
+            def pipe(files, **kw):
+                return TextPipeline(
+                    files, Tokenizer(kind="word", vocab_size=128), seq_len=48,
+                    batch_size=4, seed=7, epochs=2, **kw
+                )
+
+            def collect(p):
+                return [
+                    tuple(np.array(b[k]).tobytes() for k in ("tokens", "segment_ids", "positions"))
+                    for b in p
+                ]
+
+            local = collect(pipe(paths))
+            assert local, "text pipeline yielded nothing"
+            assert collect(pipe(urls, prefetch="0")) == local  # cold remote
+            assert collect(pipe(urls, prefetch="2")) == local  # stage + commit
+            assert collect(pipe(urls, prefetch="2")) == local  # warm tier
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+# -- chaos sites ------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestStoreChaos:
+    def test_read_error_is_retried_and_counted(self, http_corpus):
+        """store.read_error: bounded injected request failures are absorbed
+        by STORE_READ_RETRY — stream identical, faults counted."""
+        _, _, paths, urls = http_corpus
+        chaos.install(
+            chaos.ChaosPlan(
+                seed=5,
+                sites={"store.read_error": {"probability": 1.0, "max_count": 2}},
+            )
+        )
+        before = _counter("chaos_fault_store_read_error_total")
+        store = HTTPStore(range_bytes=512)
+        recs = _records(store.read_records_chunked(urls[0], chunk_records=16))
+        assert recs == list(tfrecord.read_records(paths[0]))
+        assert _counter("chaos_fault_store_read_error_total") == before + 2
+
+    def test_remote_stall_delays_but_streams(self, http_corpus):
+        _, _, paths, urls = http_corpus
+        chaos.install(
+            chaos.ChaosPlan(
+                seed=6,
+                sites={
+                    "store.remote_stall": {
+                        "probability": 1.0, "max_count": 3, "delay_s": 0.01,
+                    }
+                },
+            )
+        )
+        before = _counter("chaos_fault_store_remote_stall_total")
+        store = HTTPStore(range_bytes=512)
+        recs = _records(store.read_records_chunked(urls[0], chunk_records=16))
+        assert recs == list(tfrecord.read_records(paths[0]))
+        assert _counter("chaos_fault_store_remote_stall_total") == before + 3
+
+    def test_prefetch_tear_rejected_by_verify(self, http_corpus, tmp_path):
+        """store.prefetch_tear publishes a torn MANIFEST.json; the commit's
+        own verify rejects it and the shard is served cold — never garbage."""
+        _, _, paths, urls = http_corpus
+        chaos.install(
+            chaos.ChaosPlan(
+                seed=7,
+                sites={"store.prefetch_tear": {"probability": 1.0, "max_count": 1}},
+            )
+        )
+        before = _counter("store_prefetch_rejects_total")
+        store = HTTPStore(range_bytes=512)
+        stager = staging.PrefetchStager(store, root=str(tmp_path / "stage"), depth=1)
+        try:
+            stager.plan(urls[:1])
+            data = stager.fetch(urls[0])  # torn publish -> rejected -> None
+            assert _counter("store_prefetch_rejects_total") > before
+            if data is not None:  # a post-tear re-stage is allowed, but
+                # only with verified bytes
+                assert open(data, "rb").read() == open(paths[0], "rb").read()
+        finally:
+            stager.close()
+
+    def test_torn_stage_never_pollutes_the_stream(self, http_corpus, tmp_path, monkeypatch):
+        _, _, paths, urls = http_corpus
+        monkeypatch.setenv(staging.DIR_ENV, str(tmp_path / "stage"))
+        local = _stream(
+            ImagePipeline(paths, _parse, batch_size=8, seed=3, epochs=2, num_threads=2)
+        )
+        chaos.install(
+            chaos.ChaosPlan(
+                seed=8,
+                sites={"store.prefetch_tear": {"probability": 0.5, "max_count": 2}},
+            )
+        )
+        torn = _stream(
+            ImagePipeline(
+                urls, _parse, batch_size=8, seed=3, epochs=2, num_threads=2,
+                prefetch="2",
+            )
+        )
+        assert torn == local
+
+
+# -- slab-cache tier hierarchy ---------------------------------------------
+
+
+class TestSlabCacheTiers:
+    def _fill(self, cache, n, base=0):
+        for i in range(base, base + n):
+            cache.put(i, np.full((4, 4, 1), i % 251, np.uint8), i)
+        return cache.commit()
+
+    def test_disk_hit_promotes_into_ram(self, tmp_path):
+        from tensorflowonspark_tpu.data.slab_cache import SlabCache
+
+        cache = SlabCache(str(tmp_path), "k", (4, 4, 1), np.uint8, ram_bytes=1 << 20)
+        try:
+            assert self._fill(cache, 8) == 8
+            ram_b = _counter("tier_ram_hits_total")
+            disk_b = _counter("tier_disk_hits_total")
+            promote_b = _counter("tier_promotions_total")
+            pixels, label = cache.lookup(3)
+            assert label == 3 and pixels[0, 0, 0] == 3
+            assert _counter("tier_disk_hits_total") == disk_b + 1
+            assert _counter("tier_promotions_total") == promote_b + 1
+            pixels2, label2 = cache.lookup(3)  # now RAM-resident
+            assert label2 == 3 and np.array_equal(np.array(pixels), np.array(pixels2))
+            assert _counter("tier_ram_hits_total") == ram_b + 1
+        finally:
+            cache.close()
+
+    def test_ram_bound_demotes_lru_rows(self, tmp_path):
+        from tensorflowonspark_tpu.data.slab_cache import SlabCache
+
+        # room for exactly 2 rows of 16 bytes in RAM
+        cache = SlabCache(str(tmp_path), "k", (4, 4, 1), np.uint8, ram_bytes=32)
+        try:
+            self._fill(cache, 6)
+            demote_b = _counter("tier_demotions_total")
+            for i in range(4):
+                cache.lookup(i)
+            assert _counter("tier_demotions_total") >= demote_b + 2
+            # demoted rows still answer from disk, byte-identical
+            pixels, label = cache.lookup(0)
+            assert label == 0 and pixels[0, 0, 0] == 0
+        finally:
+            cache.close()
+
+    def test_disk_capacity_evicts_whole_generations(self, tmp_path):
+        from tensorflowonspark_tpu.data.slab_cache import SlabCache
+
+        row = 16  # 4*4*1 uint8
+        cache = SlabCache(
+            str(tmp_path), "k", (4, 4, 1), np.uint8, max_bytes=10 * row, ram_bytes=0
+        )
+        try:
+            evict_b = _counter("tier_evictions_total")
+            self._fill(cache, 8, base=0)  # gen 0: 8 rows
+            self._fill(cache, 8, base=100)  # gen 1: 8 rows -> over 10-row cap
+            assert _counter("tier_evictions_total") > evict_b
+            # the oldest generation went; the newest survives
+            assert cache.lookup(0) is None
+            assert cache.lookup(100) is not None
+        finally:
+            cache.close()
+
+    def test_lookup_recency_steers_disk_eviction(self, tmp_path):
+        from tensorflowonspark_tpu.data.slab_cache import SlabCache
+
+        row = 16
+        cache = SlabCache(
+            str(tmp_path), "k", (4, 4, 1), np.uint8, max_bytes=17 * row, ram_bytes=0
+        )
+        try:
+            self._fill(cache, 8, base=0)  # gen 0
+            self._fill(cache, 8, base=100)  # gen 1 (16 rows: still under cap)
+            assert cache.lookup(0) is not None  # touch gen 0: it is now MRU
+            self._fill(cache, 8, base=200)  # gen 2 -> evict LRU = gen 1
+            assert cache.lookup(100) is None
+            assert cache.lookup(1) is not None
+            assert cache.lookup(200) is not None
+        finally:
+            cache.close()
+
+    def test_reopen_respects_capacity(self, tmp_path):
+        from tensorflowonspark_tpu.data.slab_cache import SlabCache
+
+        row = 16
+        cache = SlabCache(str(tmp_path), "k", (4, 4, 1), np.uint8, ram_bytes=0)
+        try:
+            self._fill(cache, 8, base=0)
+            self._fill(cache, 8, base=100)
+        finally:
+            cache.close()
+        warm = SlabCache(
+            str(tmp_path), "k", (4, 4, 1), np.uint8, max_bytes=10 * row, ram_bytes=0
+        )
+        try:
+            # reopen under a tighter bound: older generations are evicted
+            # at load, the newest still serves
+            assert warm.lookup(100) is not None
+            assert warm.lookup(0) is None
+        finally:
+            warm.close()
+
+
+# -- backend fingerprint (bench provenance) ---------------------------------
+
+
+class TestBackendFingerprint:
+    def test_note_backend_records_last_read_source(self, tmp_path, http_corpus):
+        _, d, paths, urls = http_corpus
+        LocalStore().read_records(paths[0])
+        assert base.active_fingerprint() == "local"
+        store = HTTPStore(range_bytes=512)
+        store.read_records(urls[0])
+        assert base.active_fingerprint().startswith("http adapter=IndexHtmlAdapter")
